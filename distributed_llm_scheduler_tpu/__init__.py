@@ -1,0 +1,61 @@
+"""distributed_llm_scheduler_tpu — TPU-native memory-constrained DAG
+scheduling and execution for LLMs.
+
+A brand-new framework with the capability surface of the reference
+``2alaaa/distributed-llm-scheduler`` (DAG extraction → memory-constrained
+scheduling → execution → evaluation/visualization), rebuilt TPU-first:
+
+* tasks are XLA-compilable computations with real byte sizes;
+* nodes are TPU cores on a ``jax.sharding.Mesh`` under HBM budgets;
+* transfers are ``jax.device_put`` / ICI collectives with measured cost;
+* the reference's simulated executor survives as a pluggable CPU-runnable
+  backend next to the real device backend;
+* plus native-scale subsystems the reference lacks: sharded training
+  (DP/FSDP/TP/SP), ring attention for long context, Pallas kernels,
+  checkpointing, config/CLI.
+
+See SURVEY.md for the layer map and parity notes.
+"""
+
+from .core.graph import (
+    DEFAULT_PARAM_GB,
+    GraphValidationError,
+    Task,
+    TaskGraph,
+    TaskStatus,
+)
+from .core.cluster import Cluster, DeviceState, estimate_cluster_memory_needed
+from .core.schedule import Schedule, TaskTiming
+from .sched.base import BaseScheduler
+from .sched.policies import (
+    ALL_SCHEDULERS,
+    CriticalPathScheduler,
+    DFSScheduler,
+    GreedyScheduler,
+    MRUScheduler,
+    RoundRobinScheduler,
+    get_scheduler,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DEFAULT_PARAM_GB",
+    "GraphValidationError",
+    "Task",
+    "TaskGraph",
+    "TaskStatus",
+    "Cluster",
+    "DeviceState",
+    "estimate_cluster_memory_needed",
+    "Schedule",
+    "TaskTiming",
+    "BaseScheduler",
+    "ALL_SCHEDULERS",
+    "RoundRobinScheduler",
+    "DFSScheduler",
+    "GreedyScheduler",
+    "CriticalPathScheduler",
+    "MRUScheduler",
+    "get_scheduler",
+]

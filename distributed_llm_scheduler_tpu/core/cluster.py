@@ -1,0 +1,196 @@
+"""Cluster model: memory-limited accelerator cores.
+
+Capability parity with the reference's ``Node`` (reference
+``schedulers.py:19-29``): each device has a total memory budget, an available
+counter, a compute-speed multiplier, a set of resident ("cached") parameters,
+and an MRU recency deque.  TPU-first differences:
+
+* a device can be bound to a real ``jax.Device`` (one TPU core of a mesh);
+  its memory budget then defaults to the core's HBM capacity, and placement
+  decisions made against this model are executed for real by the device
+  backend.
+* parameter sizes are real bytes (via the owning :class:`TaskGraph`), not a
+  0.5 GB constant — the constant remains only as the default for synthetic
+  workloads.
+* heterogeneous ``compute_speed`` does not exist on a TPU slice (all cores
+  are identical); we keep it for the simulated backend and parity tests, and
+  reframe heterogeneity on real hardware as per-core HBM budgets.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Sequence, Set
+
+# MRU recency window size, as in reference schedulers.py:28 (deque maxlen=10).
+MRU_WINDOW = 10
+
+
+@dataclass
+class DeviceState:
+    """One schedulable core: memory budget + parameter cache.
+
+    ``jax_device`` is optionally a live ``jax.Device``; the scheduler layer
+    never touches it, only the execution backend does.
+    """
+
+    node_id: str
+    total_memory: float  # GB
+    compute_speed: float = 1.0
+    jax_device: Optional[Any] = None
+
+    available_memory: float = field(init=False)
+    cached_params: Set[str] = field(default_factory=set)
+    running_tasks: List[str] = field(default_factory=list)
+    completed_tasks: List[str] = field(default_factory=list)
+    mru_params: Deque[str] = field(default_factory=lambda: deque(maxlen=MRU_WINDOW))
+
+    def __post_init__(self) -> None:
+        self.available_memory = self.total_memory
+
+    # -- cache bookkeeping -------------------------------------------------
+    def touch_param(self, param: str) -> None:
+        """Record recency: move param to MRU front."""
+        try:
+            self.mru_params.remove(param)
+        except ValueError:
+            pass
+        self.mru_params.appendleft(param)
+
+    def reset(self) -> None:
+        self.available_memory = self.total_memory
+        self.cached_params.clear()
+        self.running_tasks.clear()
+        self.completed_tasks.clear()
+        self.mru_params.clear()
+
+    @property
+    def used_memory(self) -> float:
+        return self.total_memory - self.available_memory
+
+    def __repr__(self) -> str:
+        return (
+            f"DeviceState({self.node_id!r}, {self.available_memory:.2f}/"
+            f"{self.total_memory:.2f}GB free, speed={self.compute_speed}, "
+            f"{len(self.cached_params)} params cached)"
+        )
+
+
+class Cluster:
+    """An ordered collection of :class:`DeviceState`.
+
+    Constructors cover the reference's provisioning profiles (reference
+    ``simulation.py:161-190`` and ``test_gpt2.py:278-283``) plus a
+    TPU-backed constructor that derives budgets from live device HBM.
+    """
+
+    def __init__(self, devices: Sequence[DeviceState]):
+        if not devices:
+            raise ValueError("cluster needs at least one device")
+        ids = [d.node_id for d in devices]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate device ids: {ids}")
+        self.devices: List[DeviceState] = list(devices)
+        self._by_id: Dict[str, DeviceState] = {d.node_id: d for d in devices}
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    def __iter__(self):
+        return iter(self.devices)
+
+    def __getitem__(self, node_id: str) -> DeviceState:
+        return self._by_id[node_id]
+
+    def __contains__(self, node_id: str) -> bool:
+        return node_id in self._by_id
+
+    def ids(self) -> List[str]:
+        return [d.node_id for d in self.devices]
+
+    def total_memory(self) -> float:
+        return sum(d.total_memory for d in self.devices)
+
+    def reset(self) -> None:
+        for d in self.devices:
+            d.reset()
+
+    # -- provisioning profiles --------------------------------------------
+    @classmethod
+    def uniform(cls, n: int, memory_gb: float, speed: float = 1.0,
+                prefix: str = "core") -> "Cluster":
+        return cls([
+            DeviceState(f"{prefix}_{i}", memory_gb, speed) for i in range(n)
+        ])
+
+    @classmethod
+    def heterogeneous(cls, total_memory: float, num_nodes: int,
+                      rng: Optional[random.Random] = None) -> "Cluster":
+        """Reference memory-regime provisioning profiles.
+
+        2 nodes: 60/40 split, speeds 1.2/1.0; 4 nodes: 35/25/25/15, speeds
+        1.2/1.0/1.0/0.8; otherwise equal split with speeds drawn uniformly
+        from 0.7-1.3 (reference ``simulation.py:161-190``), seedable here
+        (the reference draws unseeded, so its sweeps aren't reproducible).
+        """
+        rng = rng or random.Random(0)
+        if num_nodes == 2:
+            fracs, speeds = [0.60, 0.40], [1.2, 1.0]
+        elif num_nodes == 4:
+            fracs, speeds = [0.35, 0.25, 0.25, 0.15], [1.2, 1.0, 1.0, 0.8]
+        else:
+            fracs = [1.0 / num_nodes] * num_nodes
+            speeds = [rng.uniform(0.7, 1.3) for _ in range(num_nodes)]
+        return cls([
+            DeviceState(f"node_{i}", total_memory * f, s)
+            for i, (f, s) in enumerate(zip(fracs, speeds))
+        ])
+
+    @classmethod
+    def laptops(cls) -> "Cluster":
+        """The reference's 4-laptop fleet (reference test_gpt2.py:278-283)."""
+        profile = [("laptop_0", 8.0, 1.0), ("laptop_1", 8.0, 1.2),
+                   ("laptop_2", 6.0, 0.8), ("laptop_3", 6.0, 0.9)]
+        return cls([DeviceState(n, m, s) for n, m, s in profile])
+
+    @classmethod
+    def from_jax_devices(cls, devices: Optional[Sequence[Any]] = None,
+                         hbm_cap_gb: Optional[float] = None) -> "Cluster":
+        """Build from live JAX devices (one DeviceState per core).
+
+        HBM budget per core comes from ``memory_stats()`` when the platform
+        reports it (TPU does), else ``hbm_cap_gb``, else a conservative
+        default.  Cores are identical, so ``compute_speed`` is 1.0; use
+        ``hbm_cap_gb`` to emulate constrained memory regimes on real
+        hardware (the TPU analog of the reference's regime sweep).
+        """
+        import jax
+
+        devices = list(devices if devices is not None else jax.devices())
+        out = []
+        for i, dev in enumerate(devices):
+            cap = hbm_cap_gb
+            if cap is None:
+                try:
+                    stats = dev.memory_stats() or {}
+                    limit = stats.get("bytes_limit")
+                    cap = limit / 1024**3 if limit else 16.0
+                except Exception:
+                    cap = 16.0
+            out.append(DeviceState(f"core_{i}", cap, 1.0, jax_device=dev))
+        return cls(out)
+
+    def __repr__(self) -> str:
+        return f"Cluster({len(self.devices)} devices, {self.total_memory():.1f}GB total)"
+
+
+def estimate_cluster_memory_needed(graph) -> float:
+    """Lower-bound cluster memory for a graph: the reference's estimator.
+
+    max single-task activation footprint + per-param cache cost over unique
+    params (reference ``simulation.py:194-214``), generalized to real param
+    sizes.  Used to size memory regimes.
+    """
+    return graph.max_task_memory() + graph.total_param_gb()

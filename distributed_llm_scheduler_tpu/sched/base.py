@@ -1,0 +1,199 @@
+"""Scheduler base: the memory-constrained list-scheduling state machine.
+
+Behavior parity with the reference ``BaseScheduler`` (reference
+``schedulers.py:31-135``), with its de-facto contract preserved:
+
+* memory requirement of a task on a node = activation footprint + size of
+  every needed param **not already cached** there
+  (reference ``schedulers.py:63-76``);
+* assignment loads params into the node cache (debiting memory permanently
+  until evicted) and **immediately completes** the task, crediting back only
+  the activation memory (reference ``schedulers.py:78-126``) — list
+  scheduling decides placement and order, a backend decides time;
+* a ready task that fits on no node is failed permanently
+  (reference ``schedulers.py:198-200``);
+* a full round with no progress fails all remaining pending tasks
+  (reference ``schedulers.py:202-206``);
+* round loop is bounded by ``2 * len(tasks)`` iterations
+  (reference ``schedulers.py:160`` et al.).
+
+Differences (deliberate):
+
+* state lives in a per-run :class:`SchedulerRun`, so graphs/clusters need no
+  deep-copying between trials (the reference deep-copies,
+  ``simulation.py:309-317``);
+* param sizes are real bytes via ``Task.param_size_gb`` (0.5 GB default);
+* the returned :class:`Schedule` also records global assignment order.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core.cluster import Cluster, DeviceState
+from ..core.graph import Task, TaskGraph, TaskStatus
+from ..core.schedule import Schedule
+
+
+class SchedulerRun:
+    """Mutable state for one scheduling pass over (graph, cluster)."""
+
+    def __init__(self, graph: TaskGraph, cluster: Cluster):
+        graph.freeze()
+        graph.reset()
+        cluster.reset()
+        self.graph = graph
+        self.cluster = cluster
+        self.pending: Set[str] = set(graph.task_ids())
+        self.completed: Set[str] = set()
+        self.failed: Set[str] = set()
+        # param -> set of node_ids currently holding it
+        # (reference ``param_locations``, schedulers.py:40)
+        self.param_locations: Dict[str, Set[str]] = {}
+        self.per_node: Dict[str, List[str]] = {d.node_id: [] for d in cluster}
+        self.assignment_order: List[str] = []
+
+
+class BaseScheduler:
+    """Subclasses override :meth:`run_policy` (the reference's ``schedule``)."""
+
+    name = "base"
+
+    # -- queries -----------------------------------------------------------
+    def is_task_ready(self, run: SchedulerRun, tid: str) -> bool:
+        return all(d in run.completed for d in run.graph[tid].dependencies)
+
+    def get_ready_tasks(self, run: SchedulerRun) -> List[Task]:
+        """Pending tasks whose deps are all complete, in graph insertion order.
+
+        Full scan per round, as the reference does (schedulers.py:55-61);
+        insertion order kept for determinism parity.
+        """
+        return [
+            run.graph[tid]
+            for tid in run.graph.task_ids()
+            if tid in run.pending and self.is_task_ready(run, tid)
+        ]
+
+    def memory_requirement(self, run: SchedulerRun, task: Task,
+                           node: DeviceState) -> float:
+        """Activation GB + GB of params that would need loading on `node`.
+
+        All sizes come from the graph's table fixed at freeze() so debits
+        and (eviction) credits can never disagree.
+        """
+        need = task.memory_required
+        for p in task.params_needed:
+            if p not in node.cached_params:
+                need += run.graph.param_size_gb(p)
+        return need
+
+    def can_fit(self, run: SchedulerRun, task: Task, node: DeviceState) -> bool:
+        return self.memory_requirement(run, task, node) <= node.available_memory + 1e-9
+
+    # -- transitions -------------------------------------------------------
+    def assign(self, run: SchedulerRun, task: Task, node: DeviceState) -> None:
+        """Load params, debit memory, place task — then instantly complete.
+
+        Mirrors reference ``assign_task_to_node`` + ``complete_task``
+        (schedulers.py:78-126): params stay cached after completion; only
+        the activation footprint is returned.
+        """
+        for p in sorted(task.params_needed):
+            if p not in node.cached_params:
+                node.cached_params.add(p)
+                node.available_memory -= run.graph.param_size_gb(p)
+                run.param_locations.setdefault(p, set()).add(node.node_id)
+            node.touch_param(p)
+        node.available_memory -= task.memory_required
+        task.assigned_node = node.node_id
+        task.status = TaskStatus.ASSIGNED
+        node.running_tasks.append(task.task_id)
+        run.per_node[node.node_id].append(task.task_id)
+        run.assignment_order.append(task.task_id)
+        run.pending.discard(task.task_id)
+        self.complete(run, task, node)
+
+    def complete(self, run: SchedulerRun, task: Task, node: DeviceState) -> None:
+        node.available_memory += task.memory_required
+        node.running_tasks.remove(task.task_id)
+        node.completed_tasks.append(task.task_id)
+        task.status = TaskStatus.COMPLETED
+        run.completed.add(task.task_id)
+
+    def fail(self, run: SchedulerRun, task: Task) -> None:
+        task.status = TaskStatus.FAILED
+        run.pending.discard(task.task_id)
+        run.failed.add(task.task_id)
+
+    def evict_param(self, run: SchedulerRun, node: DeviceState, param: str,
+                    size_gb: float) -> None:
+        """Drop a cached param from a node, crediting its memory back."""
+        node.cached_params.discard(param)
+        try:
+            node.mru_params.remove(param)
+        except ValueError:
+            pass
+        node.available_memory += size_gb
+        locs = run.param_locations.get(param)
+        if locs:
+            locs.discard(node.node_id)
+
+    # -- driver ------------------------------------------------------------
+    def schedule(self, graph: TaskGraph, cluster: Cluster) -> Schedule:
+        run = SchedulerRun(graph, cluster)
+        t0 = time.perf_counter()
+        self.run_policy(run)
+        wall = time.perf_counter() - t0
+        return Schedule(
+            policy=self.name,
+            per_node=run.per_node,
+            assignment_order=run.assignment_order,
+            completed=run.completed,
+            failed=run.failed,
+            scheduling_wall_s=wall,
+        )
+
+    def run_policy(self, run: SchedulerRun) -> None:
+        raise NotImplementedError
+
+    # Shared round-loop skeleton used by every policy (reference quirks:
+    # iteration bound, fail-on-no-fit, no-progress bailout).
+    def _round_loop(self, run: SchedulerRun, order_fn, pick_node_fn) -> None:
+        """Generic list-scheduling loop.
+
+        ``order_fn(run, ready) -> List[Task]`` sorts the ready set;
+        ``pick_node_fn(run, task, ready_ids) -> Optional[DeviceState]`` picks
+        a target (may mutate state, e.g. MRU eviction on the chosen node).
+        ``ready_ids`` is this round's still-pending ready set, so policies
+        that score against it (MRU) need no per-pick graph rescans.
+        """
+        max_rounds = 2 * len(run.graph)
+        rounds = 0
+        while run.pending and rounds < max_rounds:
+            rounds += 1
+            ready = self.get_ready_tasks(run)
+            if not ready:
+                if run.pending:
+                    # deps failed upstream (or graph bug): nothing will ever
+                    # become ready — fail the remainder
+                    for tid in sorted(run.pending):
+                        self.fail(run, run.graph[tid])
+                break
+            progressed = False
+            ordered = order_fn(run, ready)
+            for task in ordered:
+                ready_ids = [
+                    t.task_id for t in ordered if t.task_id in run.pending
+                ]
+                node = pick_node_fn(run, task, ready_ids)
+                if node is None:
+                    self.fail(run, task)
+                else:
+                    self.assign(run, task, node)
+                    progressed = True
+            if not progressed and run.pending:
+                for tid in sorted(run.pending):
+                    self.fail(run, run.graph[tid])
+                break

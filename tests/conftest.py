@@ -1,0 +1,46 @@
+"""Test configuration: fake an 8-device CPU mesh before JAX initializes.
+
+Mirrors how the reference tests "multi-node" behavior without a cluster
+(in-process simulation, SURVEY.md §4): scheduler logic runs on plain Python
+objects, and device-backend / sharding tests run against 8 virtual CPU
+devices via ``--xla_force_host_platform_device_count`` so no TPU is needed.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, DeviceState, Task, TaskGraph
+
+
+@pytest.fixture
+def diamond_graph() -> TaskGraph:
+    """The reference's canonical 4-task diamond fixture
+    (reference schedulers.py:534-543): t1 -> {t2, t3} -> t4."""
+    g = TaskGraph(
+        [
+            Task("t1", 1.0, 2.0, [], {"p1"}),
+            Task("t2", 1.5, 3.0, ["t1"], {"p2"}),
+            Task("t3", 0.8, 1.5, ["t1"], {"p1", "p3"}),
+            Task("t4", 1.2, 2.5, ["t2", "t3"], {"p2", "p3"}),
+        ],
+        name="diamond",
+    )
+    return g.freeze()
+
+
+@pytest.fixture
+def two_nodes() -> Cluster:
+    """The reference smoke-test cluster (schedulers.py:545-548)."""
+    return Cluster(
+        [DeviceState("node_0", 3.0, 1.0), DeviceState("node_1", 2.5, 1.2)]
+    )

@@ -1,0 +1,75 @@
+"""Core graph model tests."""
+
+import pytest
+
+from distributed_llm_scheduler_tpu import (
+    DEFAULT_PARAM_GB,
+    GraphValidationError,
+    Task,
+    TaskGraph,
+)
+from distributed_llm_scheduler_tpu.core.graph import GB
+
+
+def test_duplicate_id_rejected():
+    g = TaskGraph([Task("a", 1, 1)])
+    with pytest.raises(GraphValidationError):
+        g.add_task(Task("a", 1, 1))
+
+
+def test_unknown_dep_rejected():
+    g = TaskGraph([Task("a", 1, 1, ["missing"])])
+    with pytest.raises(GraphValidationError):
+        g.freeze()
+
+
+def test_cycle_rejected():
+    g = TaskGraph([Task("a", 1, 1, ["b"]), Task("b", 1, 1, ["a"])])
+    with pytest.raises(GraphValidationError):
+        g.freeze()
+
+
+def test_topo_order_respects_deps(diamond_graph):
+    order = diamond_graph.topo_order
+    pos = {tid: i for i, tid in enumerate(order)}
+    for t in diamond_graph:
+        for d in t.dependencies:
+            assert pos[d] < pos[t.task_id]
+
+
+def test_depths(diamond_graph):
+    d = diamond_graph.depths()
+    assert d == {"t1": 0, "t2": 1, "t3": 1, "t4": 2}
+
+
+def test_critical_path(diamond_graph):
+    cpl = diamond_graph.critical_path_lengths()
+    # t4 is a leaf: its own time
+    assert cpl["t4"] == pytest.approx(2.5)
+    # t2 -> t4 is the longer branch
+    assert cpl["t2"] == pytest.approx(3.0 + 2.5)
+    assert cpl["t1"] == pytest.approx(2.0 + 3.0 + 2.5)
+    assert diamond_graph.critical_path_time() == pytest.approx(7.5)
+
+
+def test_dependents(diamond_graph):
+    assert set(diamond_graph.dependents("t1")) == {"t2", "t3"}
+    assert diamond_graph.dependents("t4") == []
+    assert diamond_graph.roots() == ["t1"]
+    assert diamond_graph.leaves() == ["t4"]
+
+
+def test_param_sizes_default_and_real():
+    t = Task("a", 1, 1, params_needed={"w", "b"}, param_bytes={"w": 2 * GB})
+    assert t.param_size_gb("w") == pytest.approx(2.0)
+    assert t.param_size_gb("b") == pytest.approx(DEFAULT_PARAM_GB)
+    assert t.total_param_gb() == pytest.approx(2.5)
+
+
+def test_summary(diamond_graph):
+    s = diamond_graph.summary()
+    assert s["num_tasks"] == 4
+    assert s["num_unique_params"] == 3
+    assert s["total_param_gb"] == pytest.approx(1.5)
+    assert s["max_deps"] == 2
+    assert s["avg_deps"] == pytest.approx(4 / 4)
