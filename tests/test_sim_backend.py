@@ -1,0 +1,101 @@
+"""Simulated backend + metric tests."""
+
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, DeviceState, Task, TaskGraph, get_scheduler
+from distributed_llm_scheduler_tpu.backends.sim import (
+    LinkModel,
+    SimulatedBackend,
+    calculate_load_balance,
+)
+
+
+def run(graph, cluster, policy="greedy", **kw):
+    s = get_scheduler(policy).schedule(graph, cluster)
+    return SimulatedBackend(**kw).execute(graph, cluster, s)
+
+
+def test_reference_mode_makespan_is_per_node_sum(diamond_graph, two_nodes):
+    """In reference fidelity, makespan = max over nodes of sum(time/speed)
+    (reference simulation.py:216-278 ignores dependency waits)."""
+    sched = get_scheduler("greedy").schedule(diamond_graph, two_nodes)
+    rep = SimulatedBackend(fidelity="reference").execute(
+        diamond_graph, two_nodes, sched
+    )
+    expected = {}
+    for node_id, tids in sched.per_node.items():
+        speed = two_nodes[node_id].compute_speed
+        expected[node_id] = sum(diamond_graph[t].compute_time / speed for t in tids)
+    assert rep.makespan == pytest.approx(max(expected.values()))
+    assert rep.transfer_time_total == 0.0
+    assert rep.param_load_time_total == 0.0
+
+
+def test_full_mode_respects_dependency_waits():
+    """Two sequential tasks on different nodes: the second cannot start
+    before the first finishes — full mode must show that, reference mode
+    hides it (the reference's central fidelity gap)."""
+    g = TaskGraph(
+        [Task("a", 0.1, 1.0, [], set()), Task("b", 0.1, 1.0, ["a"], set())]
+    ).freeze()
+    cluster = Cluster([DeviceState("n0", 4.0), DeviceState("n1", 4.0)])
+    # force cross-node placement with round-robin
+    s = get_scheduler("roundrobin").schedule(g, cluster)
+    assert s.placement["a"] != s.placement["b"]
+
+    ref = SimulatedBackend(fidelity="reference").execute(g, cluster, s)
+    assert ref.makespan == pytest.approx(1.0)  # both nodes "run in parallel"
+
+    full = SimulatedBackend(fidelity="full").execute(g, cluster, s)
+    assert full.makespan > 2.0  # b waits for a + transfer
+    assert full.transfer_time_total > 0.0
+
+
+def test_full_mode_charges_param_loads():
+    g = TaskGraph([Task("a", 0.1, 1.0, [], {"w"})]).freeze()
+    cluster = Cluster([DeviceState("n0", 4.0)])
+    s = get_scheduler("greedy").schedule(g, cluster)
+    link = LinkModel(param_load_gbps=0.5, interconnect_gbps=None, latency_s=0.0)
+    rep = SimulatedBackend(fidelity="full", link=link).execute(g, cluster, s)
+    # 0.5 GB param at 0.5 GB/s = 1 s load + 1 s compute
+    assert rep.makespan == pytest.approx(2.0)
+    assert rep.param_load_time_total == pytest.approx(1.0)
+
+
+def test_cache_hits_counted_for_shared_params():
+    g = TaskGraph(
+        [
+            Task("a", 0.1, 1.0, [], {"w"}),
+            Task("b", 0.1, 1.0, ["a"], {"w"}),
+        ]
+    ).freeze()
+    cluster = Cluster([DeviceState("n0", 4.0)])
+    rep = run(g, cluster, "greedy")
+    assert rep.cache_misses == 1
+    assert rep.cache_hits == 1
+    assert rep.cache_hit_rate == pytest.approx(0.5)
+
+
+def test_timings_are_gantt_ready(diamond_graph, two_nodes):
+    rep = run(diamond_graph, two_nodes, "mru")
+    assert set(rep.timings) == {"t1", "t2", "t3", "t4"}
+    for t in rep.timings.values():
+        assert t.finish > t.start
+    # t4 starts after both t2 and t3 finish
+    assert rep.timings["t4"].start >= max(
+        rep.timings["t2"].finish, rep.timings["t3"].finish
+    )
+
+
+def test_load_balance_metric():
+    assert calculate_load_balance({"a": 1.0, "b": 1.0}) == pytest.approx(1.0)
+    balanced = calculate_load_balance({"a": 1.0, "b": 1.0, "c": 1.0})
+    skewed = calculate_load_balance({"a": 3.0, "b": 0.0, "c": 0.0})
+    assert balanced > skewed
+    assert calculate_load_balance({}) == 1.0
+
+
+def test_utilization_bounded(diamond_graph, two_nodes):
+    rep = run(diamond_graph, two_nodes, "critical")
+    for v in rep.node_utilization.values():
+        assert 0.0 <= v <= 1.0 + 1e-9
