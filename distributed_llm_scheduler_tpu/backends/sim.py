@@ -114,13 +114,17 @@ class ExecutionReport:
 
 
 def calculate_load_balance(per_node_load: Dict[str, float]) -> float:
-    """1/(1+CV) over per-node compute loads (reference simulation.py:280-302)."""
+    """1/(1+CV) over per-node compute loads (reference simulation.py:280-302).
+
+    Zero/empty loads score 0 (as in the reference): a schedule that ran
+    nothing must not outrank working schedulers on balance.
+    """
     loads = list(per_node_load.values())
     if not loads or all(v == 0 for v in loads):
-        return 1.0
+        return 0.0
     mean = sum(loads) / len(loads)
     if mean == 0:
-        return 1.0
+        return 0.0
     var = sum((v - mean) ** 2 for v in loads) / len(loads)
     cv = var**0.5 / mean
     return 1.0 / (1.0 + cv)

@@ -70,6 +70,7 @@ class Evaluator:
         import inspect
         import random
 
+        self.reports = []  # each sweep stands alone; no stale-row mixing
         for dag_type, make_graph in self.workloads.items():
             takes_seed = "seed" in inspect.signature(make_graph).parameters
             for run_idx in range(num_runs):

@@ -1,9 +1,15 @@
 """Synthetic workload generators.
 
 Capability parity with the reference's ``DAGGenerator``
-(reference ``simulation.py:33-151``): three DAG families with the same
-shapes, sizes, and parameter-sharing patterns, but seedable (the reference
-draws unseeded RNG, so its sweeps aren't reproducible — SURVEY.md §4).
+(reference ``simulation.py:33-151``): the same three DAG *families* and
+topologies (parallel attention heads, ≤3-dep random, all-to-all pipeline
+stages), seedable (the reference draws unseeded RNG, so its sweeps aren't
+reproducible — SURVEY.md §4).  Sizes and parameter-sharing are deliberate
+variants, not byte-identical to the reference: attention weights are shared
+across a layer's heads and the output is weight-tied to the embedding so
+locality policies face the same sharing patterns real models have.  Parity
+against the paper's *numbers* therefore holds qualitatively (ordering of
+schedulers), not trial-for-trial.
 
 Families:
 

@@ -1,0 +1,225 @@
+"""GPT-2 in pure JAX: the flagship model family.
+
+A from-scratch functional implementation (no flax/haiku): params are a flat
+``Dict[str, jax.Array]`` keyed by the same names the DAG frontend uses for
+its tasks' ``params_needed`` sets, so scheduler placement and real execution
+share one vocabulary.  The reference extracts model *structure* from
+HuggingFace GPT2Model with random weights (reference ``test_gpt2.py:45-48``);
+here the model is ours, so structure, weights, and per-op functions all come
+from the same place.
+
+Every per-op function (`layer_norm`, `attention`, `ffn_*`, …) is
+individually jittable — the DAG frontend wraps them as task fns — and
+`forward` composes them into the whole-model forward used as the fused
+single-program baseline and the correctness oracle for DAG execution.
+
+TPU notes: matmul-heavy ops run in the model dtype (bfloat16 by default on
+TPU) to hit the MXU; layer norms accumulate in float32 for stability.
+Static shapes everywhere; causal masking via `jnp.where` on an affine
+index grid (no dynamic slicing), so XLA tiles cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dtype: Any = jnp.float32
+    ln_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @classmethod
+    def small(cls, **kw) -> "GPT2Config":
+        """124M — the reference's extraction target (test_gpt2.py:47)."""
+        return cls(**kw)
+
+    @classmethod
+    def medium(cls, **kw) -> "GPT2Config":
+        """355M (BASELINE.json config #2)."""
+        return cls(n_embd=1024, n_layer=24, n_head=16, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        """Test-sized: 2 layers, 128 wide — CPU-fast, same topology."""
+        return cls(
+            vocab_size=512, n_positions=128, n_embd=128, n_layer=2, n_head=4, **kw
+        )
+
+
+# -- parameter init --------------------------------------------------------
+
+def init_params(config: GPT2Config, key: jax.Array) -> Dict[str, jax.Array]:
+    """GPT-2 initialization: N(0, 0.02) weights, zero biases, unit LN gains.
+
+    Flat naming scheme shared with the DAG frontend:
+    ``wte, wpe, ln_f_g, ln_f_b, h{i}_ln1_g, h{i}_attn_qkv_w, ...``
+    """
+    std = 0.02
+    d, dtype = config.n_embd, config.dtype
+    params: Dict[str, jax.Array] = {}
+
+    def normal(key, shape, scale=std):
+        return (scale * jax.random.normal(key, shape)).astype(dtype)
+
+    n_keys = 2 + config.n_layer * 4
+    keys = iter(jax.random.split(key, n_keys))
+
+    params["wte"] = normal(next(keys), (config.vocab_size, d))
+    params["wpe"] = normal(next(keys), (config.n_positions, d))
+    for i in range(config.n_layer):
+        p = f"h{i}_"
+        params[p + "ln1_g"] = jnp.ones((d,), dtype)
+        params[p + "ln1_b"] = jnp.zeros((d,), dtype)
+        params[p + "attn_qkv_w"] = normal(next(keys), (d, 3 * d))
+        params[p + "attn_qkv_b"] = jnp.zeros((3 * d,), dtype)
+        # residual-branch projections scaled down by sqrt(2*n_layer), as GPT-2
+        params[p + "attn_proj_w"] = normal(
+            next(keys), (d, d), std / math.sqrt(2 * config.n_layer)
+        )
+        params[p + "attn_proj_b"] = jnp.zeros((d,), dtype)
+        params[p + "ln2_g"] = jnp.ones((d,), dtype)
+        params[p + "ln2_b"] = jnp.zeros((d,), dtype)
+        params[p + "mlp_fc_w"] = normal(next(keys), (d, 4 * d))
+        params[p + "mlp_fc_b"] = jnp.zeros((4 * d,), dtype)
+        params[p + "mlp_proj_w"] = normal(
+            next(keys), (4 * d, d), std / math.sqrt(2 * config.n_layer)
+        )
+        params[p + "mlp_proj_b"] = jnp.zeros((d,), dtype)
+    params["ln_f_g"] = jnp.ones((d,), dtype)
+    params["ln_f_b"] = jnp.zeros((d,), dtype)
+    return params
+
+
+def param_shapes(config: GPT2Config) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+    """(shape, dtype) per param without materializing arrays (eval_shape)."""
+    shaped = jax.eval_shape(
+        lambda k: init_params(config, k), jax.ShapeDtypeStruct((2,), jnp.uint32)
+    )
+    return {k: (v.shape, v.dtype) for k, v in shaped.items()}
+
+
+# -- per-op functions (task granularity of the reference DAG) ---------------
+
+def layer_norm(x: jax.Array, g: jax.Array, b: jax.Array, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def embedding(input_ids: jax.Array, wte: jax.Array, wpe: jax.Array) -> jax.Array:
+    T = input_ids.shape[-1]
+    return wte[input_ids] + wpe[:T]
+
+
+def causal_attention(
+    x: jax.Array,
+    qkv_w: jax.Array,
+    qkv_b: jax.Array,
+    proj_w: jax.Array,
+    proj_b: jax.Array,
+    n_head: int,
+) -> jax.Array:
+    """Multi-head causal self-attention incl. output projection — one task,
+    matching the reference's per-layer "attention" granularity
+    (reference test_gpt2.py:75-90: qkv + proj params on a single task)."""
+    B, T, D = x.shape
+    hd = D // n_head
+    qkv = x @ qkv_w + qkv_b
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):  # (B, T, D) -> (B, n_head, T, hd)
+        return t.reshape(B, T, n_head, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    scores = jnp.where(j <= i, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
+    return out @ proj_w + proj_b
+
+
+def ffn_expand(x: jax.Array, fc_w: jax.Array, fc_b: jax.Array) -> jax.Array:
+    return x @ fc_w + fc_b
+
+
+def ffn_activation(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=True)
+
+
+def ffn_contract(x: jax.Array, proj_w: jax.Array, proj_b: jax.Array) -> jax.Array:
+    return x @ proj_w + proj_b
+
+
+def residual_add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a + b
+
+
+def output_projection(x: jax.Array, wte: jax.Array) -> jax.Array:
+    """Logits via weight tying with the embedding table
+    (reference test_gpt2.py:160-166)."""
+    return x @ wte.T
+
+
+# -- whole-model forward (fused baseline + correctness oracle) --------------
+
+def forward(
+    params: Dict[str, jax.Array], input_ids: jax.Array, config: GPT2Config
+) -> jax.Array:
+    """Full forward pass composing exactly the per-op functions above."""
+    x = embedding(input_ids, params["wte"], params["wpe"])
+    for i in range(config.n_layer):
+        p = f"h{i}_"
+        ln1 = layer_norm(x, params[p + "ln1_g"], params[p + "ln1_b"], config.ln_eps)
+        attn = causal_attention(
+            ln1,
+            params[p + "attn_qkv_w"],
+            params[p + "attn_qkv_b"],
+            params[p + "attn_proj_w"],
+            params[p + "attn_proj_b"],
+            config.n_head,
+        )
+        x = residual_add(x, attn)
+        ln2 = layer_norm(x, params[p + "ln2_g"], params[p + "ln2_b"], config.ln_eps)
+        h = ffn_expand(ln2, params[p + "mlp_fc_w"], params[p + "mlp_fc_b"])
+        h = ffn_activation(h)
+        h = ffn_contract(h, params[p + "mlp_proj_w"], params[p + "mlp_proj_b"])
+        x = residual_add(x, h)
+    x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], config.ln_eps)
+    return output_projection(x, params["wte"])
+
+
+def loss_fn(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    targets: jax.Array,
+    config: GPT2Config,
+) -> jax.Array:
+    """Next-token cross-entropy (training-step DAGs and the parallel layer)."""
+    logits = forward(params, input_ids, config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def num_params(config: GPT2Config) -> int:
+    return sum(math.prod(shape) for shape, _ in param_shapes(config).values())

@@ -8,14 +8,21 @@ devices via ``--xla_force_host_platform_device_count`` so no TPU is needed.
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes a backend.  NOTE: the JAX_PLATFORMS env
+# var alone is NOT enough here — a sitecustomize hook registers the "axon"
+# TPU plugin at interpreter start and overwrites jax_platforms, silently
+# routing every test op through the TPU tunnel (~20x slower and not the
+# 8-device mesh we want).  jax.config.update after import wins.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -77,3 +77,15 @@ def test_reference_fidelity_rejects_custom_link():
 
     with pytest.raises(ValueError):
         SimulatedBackend(fidelity="reference", link=LinkModel())
+
+
+def test_rerun_does_not_mix_stale_reports():
+    ev = Evaluator(
+        workloads={"llm": lambda seed=0: generate_llm_dag(num_layers=2, seed=seed)},
+        node_counts=(2,),
+        memory_regimes=(1.0,),
+    )
+    ev.run_experiments(num_runs=1)
+    n = len(ev.reports)
+    ev.run_experiments(num_runs=1)
+    assert len(ev.reports) == n  # second sweep replaces, not appends

@@ -1,0 +1,143 @@
+"""GPT-2 model + DAG frontend tests.
+
+The key parity checks: 99 tasks for GPT-2 small (8*12+3, reference
+test_gpt2.py:45-168 / paper §6.1), weight tying, residual edges; and the
+key *new* capability: DAG execution is numerically equivalent to the fused
+whole-model forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu.frontend.gpt2_dag import (
+    build_gpt2_dag,
+    execute_dag_locally,
+)
+from distributed_llm_scheduler_tpu.frontend.tracer import trace_to_chain
+from distributed_llm_scheduler_tpu.models import gpt2
+from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+
+@pytest.fixture(scope="module")
+def tiny_dag():
+    return build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=16)
+
+
+@pytest.fixture(scope="module")
+def small_dag():
+    return build_gpt2_dag(GPT2Config.small(), batch=1, seq_len=512)
+
+
+def test_gpt2_small_task_count(small_dag):
+    dag = small_dag
+    # 8 tasks x 12 layers + embedding + final_ln + output_projection = 99
+    assert len(dag.graph) == 99
+    s = dag.graph.summary()
+    assert s["max_deps"] == 2
+    assert abs(s["avg_deps"] - 1.23) < 0.02  # paper §6.1: avg 1.23 deps/task
+
+
+def test_weight_tying():
+    dag = build_gpt2_dag(GPT2Config.tiny(), seq_len=16)
+    emb = dag.graph["embedding"]
+    out = dag.graph["output_projection"]
+    assert "wte" in emb.params_needed and "wte" in out.params_needed
+
+
+def test_residual_edges():
+    dag = build_gpt2_dag(GPT2Config.tiny(), seq_len=16)
+    # attn_residual joins the residual stream and the attention branch
+    assert set(dag.graph["layer_0_attn_residual"].dependencies) == {
+        "embedding",
+        "layer_0_attention",
+    }
+    assert set(dag.graph["layer_1_attn_residual"].dependencies) == {
+        "layer_0_output",
+        "layer_1_attention",
+    }
+
+
+def test_real_param_bytes():
+    cfg = GPT2Config.tiny()
+    dag = build_gpt2_dag(cfg, seq_len=16)
+    attn = dag.graph["layer_0_attention"]
+    qkv_bytes = attn.param_bytes["h0_attn_qkv_w"]
+    assert qkv_bytes == cfg.n_embd * 3 * cfg.n_embd * 4  # float32
+    # total graph params must equal the model's true param count
+    total_param_bytes = sum(
+        dag.graph.param_size_gb(p) for p in dag.graph.unique_params()
+    ) * 1024**3
+    assert total_param_bytes == pytest.approx(gpt2.num_params(cfg) * 4, rel=1e-6)
+
+
+def test_num_params_gpt2_small():
+    assert gpt2.num_params(GPT2Config.small()) == pytest.approx(124e6, rel=0.02)
+
+
+def test_dag_execution_matches_fused_forward(tiny_dag):
+    """The load-bearing correctness check: task-by-task DAG execution must
+    reproduce the fused forward."""
+    params = tiny_dag.init_params()
+    ids = tiny_dag.make_inputs()
+    fused = tiny_dag.reference_forward(params, ids)
+    via_dag = execute_dag_locally(tiny_dag, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(via_dag), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_forward_is_jittable_and_causal(tiny_dag):
+    """jit compiles; causality: future tokens don't affect past logits."""
+    cfg = tiny_dag.config
+    params = tiny_dag.init_params()
+    fwd = jax.jit(lambda p, ids: gpt2.forward(p, ids, cfg))
+    ids = tiny_dag.make_inputs()
+    out1 = fwd(params, ids)
+    assert out1.shape == (2, 16, cfg.vocab_size)
+    # perturb the last token: logits at earlier positions must not change
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 1) % cfg.vocab_size)
+    out2 = fwd(params, ids2)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_loss_fn_finite(tiny_dag):
+    params = tiny_dag.init_params()
+    ids = tiny_dag.make_inputs()
+    targets = jnp.roll(ids, -1, axis=1)
+    loss = gpt2.loss_fn(params, ids, targets, tiny_dag.config)
+    assert np.isfinite(float(loss))
+    # random init: loss should be near ln(vocab)
+    assert abs(float(loss) - np.log(tiny_dag.config.vocab_size)) < 1.0
+
+
+def test_tracer_linear_chain(tiny_dag):
+    cfg = tiny_dag.config
+    params = tiny_dag.init_params()
+    ids = tiny_dag.make_inputs()
+    g = trace_to_chain(lambda i: gpt2.forward(params, i, cfg), ids, name="gpt2")
+    assert len(g) > cfg.n_layer * 4  # at least the matmul-ish ops survive
+    # linear chain: every non-root has exactly the previous task as dep
+    order = g.topo_order
+    for i, tid in enumerate(order):
+        deps = g[tid].dependencies
+        assert deps == ([] if i == 0 else [order[i - 1]])
+    # closed-over params surface as named params with real sizes
+    assert g.total_param_gb() > 0
+
+
+def test_scheduling_real_gpt2_dag(small_dag):
+    """End-to-end parity scenario (reference test_gpt2.py:274-299): schedule
+    the GPT-2 small DAG on the 4-laptop fleet with MRU -> 99/99 complete.
+    With real byte sizes the DAG is far smaller than the reference's
+    0.5GB-per-param fiction, so completion is expected."""
+    dag = small_dag
+    from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+
+    cluster = Cluster.laptops()
+    s = get_scheduler("mru").schedule(dag.graph, cluster)
+    assert len(s.completed) == 99
+    assert not s.failed

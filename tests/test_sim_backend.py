@@ -92,7 +92,9 @@ def test_load_balance_metric():
     balanced = calculate_load_balance({"a": 1.0, "b": 1.0, "c": 1.0})
     skewed = calculate_load_balance({"a": 3.0, "b": 0.0, "c": 0.0})
     assert balanced > skewed
-    assert calculate_load_balance({}) == 1.0
+    # zero work scores 0, never "perfectly balanced" (reference parity)
+    assert calculate_load_balance({}) == 0.0
+    assert calculate_load_balance({"a": 0.0, "b": 0.0}) == 0.0
 
 
 def test_utilization_bounded(diamond_graph, two_nodes):
