@@ -20,12 +20,8 @@ and an MRU recency deque.  TPU-first differences:
 from __future__ import annotations
 
 import random
-from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Deque, Dict, List, Optional, Sequence, Set
-
-# MRU recency window size, as in reference schedulers.py:28 (deque maxlen=10).
-MRU_WINDOW = 10
+from typing import Any, Dict, List, Optional, Sequence, Set
 
 
 @dataclass
@@ -33,7 +29,10 @@ class DeviceState:
     """One schedulable core: memory budget + parameter cache.
 
     ``jax_device`` is optionally a live ``jax.Device``; the scheduler layer
-    never touches it, only the execution backend does.
+    never touches it, only the execution backend does.  Param *recency* is
+    tracked by the MRU policy itself under its logical clock (the reference
+    also keeps a per-node deque, ``schedulers.py:28``, but its scheduler
+    reads its own usage dicts — we keep only the read path).
     """
 
     node_id: str
@@ -45,26 +44,15 @@ class DeviceState:
     cached_params: Set[str] = field(default_factory=set)
     running_tasks: List[str] = field(default_factory=list)
     completed_tasks: List[str] = field(default_factory=list)
-    mru_params: Deque[str] = field(default_factory=lambda: deque(maxlen=MRU_WINDOW))
 
     def __post_init__(self) -> None:
         self.available_memory = self.total_memory
-
-    # -- cache bookkeeping -------------------------------------------------
-    def touch_param(self, param: str) -> None:
-        """Record recency: move param to MRU front."""
-        try:
-            self.mru_params.remove(param)
-        except ValueError:
-            pass
-        self.mru_params.appendleft(param)
 
     def reset(self) -> None:
         self.available_memory = self.total_memory
         self.cached_params.clear()
         self.running_tasks.clear()
         self.completed_tasks.clear()
-        self.mru_params.clear()
 
     @property
     def used_memory(self) -> float:

@@ -86,12 +86,17 @@ class Task:
 
     # -- param sizing ------------------------------------------------------
     def param_size_gb(self, param: str) -> float:
-        """Size of one named parameter in GB (true size or 0.5 GB default)."""
+        """Size of one named parameter in GB **as declared on this task**
+        (0.5 GB default).  Declaration-local: a task using a param another
+        task declared sees the default here.  All scheduling/memory
+        accounting uses the authoritative graph-wide table instead
+        (``TaskGraph.param_size_gb``, fixed at ``freeze()``)."""
         if param in self.param_bytes:
             return self.param_bytes[param] / GB
         return DEFAULT_PARAM_GB
 
     def total_param_gb(self) -> float:
+        """Declaration-local total; see :meth:`param_size_gb`."""
         return sum(self.param_size_gb(p) for p in self.params_needed)
 
     @property

@@ -71,29 +71,38 @@ def trace_to_chain(
     tasks = []
     prev: Optional[str] = None
     pending_trivial = 0
-    const_names: Dict[int, str] = {
-        id(v): f"{name}_const_{i}" for i, v in enumerate(jaxpr.jaxpr.constvars)
-    }
     const_sizes = {
         f"{name}_const_{i}": _aval_bytes(v.aval)
         for i, v in enumerate(jaxpr.jaxpr.constvars)
     }
+    # var id -> set of const names it (transitively) derives from.  Skipped
+    # equations propagate origins to their outputs, so a weight consumed only
+    # through a transpose/cast/reshape still charges the downstream task.
+    const_origin: Dict[int, set] = {
+        id(v): {f"{name}_const_{i}"}
+        for i, v in enumerate(jaxpr.jaxpr.constvars)
+    }
+
+    def origins_of(eqn) -> set:
+        out: set = set()
+        for v in eqn.invars:
+            out |= const_origin.get(id(v), set())
+        return out
 
     for idx, eqn in enumerate(jaxpr.jaxpr.eqns):
         prim = eqn.primitive.name
-        if prim in _TRIVIAL_PRIMITIVES:
-            pending_trivial += 1
-            continue
         out_bytes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
-        if out_bytes < min_task_bytes:
+        if prim in _TRIVIAL_PRIMITIVES or out_bytes < min_task_bytes:
             pending_trivial += 1
+            carried = origins_of(eqn)
+            if carried:
+                for v in eqn.outvars:
+                    const_origin[id(v)] = (
+                        const_origin.get(id(v), set()) | carried
+                    )
             continue
         tid = f"{name}_op{idx}_{prim}"
-        params = {
-            const_names[id(v)]
-            for v in eqn.invars
-            if id(v) in const_names
-        }
+        params = origins_of(eqn)
         tasks.append(
             Task(
                 tid,

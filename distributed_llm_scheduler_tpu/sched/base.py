@@ -22,7 +22,8 @@ Differences (deliberate):
 * state lives in a per-run :class:`SchedulerRun`, so graphs/clusters need no
   deep-copying between trials (the reference deep-copies,
   ``simulation.py:309-317``);
-* param sizes are real bytes via ``Task.param_size_gb`` (0.5 GB default);
+* param sizes are real bytes via the graph-wide size table
+  (``TaskGraph.param_size_gb``, fixed at freeze; 0.5 GB default);
 * the returned :class:`Schedule` also records global assignment order.
 """
 
@@ -105,7 +106,6 @@ class BaseScheduler:
                 node.cached_params.add(p)
                 node.available_memory -= run.graph.param_size_gb(p)
                 run.param_locations.setdefault(p, set()).add(node.node_id)
-            node.touch_param(p)
         node.available_memory -= task.memory_required
         task.assigned_node = node.node_id
         task.status = TaskStatus.ASSIGNED
@@ -131,10 +131,6 @@ class BaseScheduler:
                     size_gb: float) -> None:
         """Drop a cached param from a node, crediting its memory back."""
         node.cached_params.discard(param)
-        try:
-            node.mru_params.remove(param)
-        except ValueError:
-            pass
         node.available_memory += size_gb
         locs = run.param_locations.get(param)
         if locs:

@@ -141,3 +141,15 @@ def test_scheduling_real_gpt2_dag(small_dag):
     s = get_scheduler("mru").schedule(dag.graph, cluster)
     assert len(s.completed) == 99
     assert not s.failed
+
+
+def test_tracer_tracks_params_through_trivial_ops():
+    """Regression: a weight consumed only via transpose/cast must still be
+    charged to the downstream task."""
+    import jax.numpy as jnp
+
+    w = jnp.ones((64, 32), jnp.float32)
+    g = trace_to_chain(lambda x: x @ w.T, jnp.ones((8, 32)), name="tw")
+    assert g.total_param_gb() > 0
+    (task,) = [t for t in g if "dot_general" in t.task_id]
+    assert task.params_needed  # the transposed const reaches the matmul
