@@ -59,6 +59,11 @@ class Task:
       fn: optional jittable computation ``fn(params_dict, *inputs) -> output``.
       arg_tasks: which dependency outputs feed ``fn``, in order.  Defaults to
         ``dependencies`` order.
+      param_alias: optional mapping local->global param names.  When set,
+        ``fn`` reads params by *local* name (e.g. ``"g"``) and the backend
+        feeds it ``{local: params[global]}``.  This lets structurally
+        identical tasks (every layer's ln1) share ONE fn object, so jit
+        compiles each op shape once instead of once per layer.
       out_shape: optional ``jax.ShapeDtypeStruct``-like spec of the output.
       flops: optional analytic FLOP count (feeds the cost model).
       group: optional label (e.g. layer index) for fusion/visualization.
@@ -72,6 +77,7 @@ class Task:
     param_bytes: Dict[str, int] = field(default_factory=dict)
     fn: Optional[Callable[..., Any]] = None
     arg_tasks: Optional[List[str]] = None
+    param_alias: Optional[Dict[str, str]] = None
     out_shape: Optional[Any] = None
     flops: Optional[float] = None
     group: Optional[str] = None
@@ -83,6 +89,16 @@ class Task:
     def __post_init__(self) -> None:
         self.dependencies = list(self.dependencies)
         self.params_needed = set(self.params_needed)
+
+    def param_items(self) -> List[Tuple[str, str]]:
+        """(fn-facing local name, global param name) pairs.
+
+        Without an alias the names coincide; with one, backends feed ``fn``
+        a dict keyed by local names resolved from global param storage.
+        """
+        if self.param_alias is not None:
+            return list(self.param_alias.items())
+        return [(p, p) for p in sorted(self.params_needed)]
 
     # -- param sizing ------------------------------------------------------
     def param_size_gb(self, param: str) -> float:

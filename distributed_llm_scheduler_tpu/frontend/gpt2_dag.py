@@ -104,68 +104,74 @@ def build_gpt2_dag(
         tid: str,
         fn: Callable[..., Any],
         deps: List[str],
-        params: List[str],
+        alias: Dict[str, str],
         flops: float,
         group: str,
     ) -> None:
+        """Register a task.  ``alias`` maps fn-local param names -> global
+        param names; structurally identical tasks (every layer's ln1, ...)
+        share ONE fn object so jit compiles each op shape once, not once
+        per layer."""
         dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
-        pspec = {p: specs[p] for p in params}
+        pspec = {loc: specs[glob] for loc, glob in alias.items()}
         out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
         out_specs[tid] = out
+        globals_ = list(alias.values())
         tasks.append(
             Task(
                 tid,
                 memory_required=_bytes_of(out) / _GB,
                 compute_time=max(flops / effective_flops, 1e-7),
                 dependencies=list(deps),
-                params_needed=set(params),
-                param_bytes={p: _bytes_of(specs[p]) for p in params},
+                params_needed=set(globals_),
+                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
                 fn=fn,
                 arg_tasks=list(deps),
+                param_alias=dict(alias),
                 out_shape=out,
                 flops=flops,
                 group=group,
             )
         )
 
-    # ---- task fns: fn(params_dict, *dep_outputs) -------------------------
+    # ---- task fns: fn(params_dict, *dep_outputs), local param names ------
     def f_embedding(p, input_ids):
         return gpt2.embedding(input_ids, p["wte"], p["wpe"])
 
-    def f_ln(p, x, *, g, b):
-        return gpt2.layer_norm(x, p[g], p[b], eps)
+    def f_ln(p, x):
+        return gpt2.layer_norm(x, p["g"], p["b"], eps)
 
-    def f_attn(p, x, *, pre):
+    def f_attn(p, x):
         return gpt2.causal_attention(
-            x, p[pre + "qkv_w"], p[pre + "qkv_b"],
-            p[pre + "proj_w"], p[pre + "proj_b"], config.n_head,
+            x, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"], config.n_head
         )
 
     def f_residual(p, a, b):
         return gpt2.residual_add(a, b)
 
-    def f_ffn_expand(p, x, *, pre):
-        return gpt2.ffn_expand(x, p[pre + "fc_w"], p[pre + "fc_b"])
+    def f_ffn_expand(p, x):
+        return gpt2.ffn_expand(x, p["fc_w"], p["fc_b"])
 
     def f_ffn_act(p, x):
         return gpt2.ffn_activation(x)
 
-    def f_ffn_contract(p, x, *, pre):
-        return gpt2.ffn_contract(x, p[pre + "proj_w"], p[pre + "proj_b"])
+    def f_ffn_contract(p, x):
+        return gpt2.ffn_contract(x, p["proj_w"], p["proj_b"])
 
     def f_output_projection(p, x):
         return gpt2.output_projection(x, p["wte"])
 
     # ---- graph assembly (8 tasks/layer + 3, reference test_gpt2.py:54-166)
-    add("embedding", f_embedding, [], ["wte", "wpe"], 2.0 * B * T * D, "embed")
+    add("embedding", f_embedding, [], {"wte": "wte", "wpe": "wpe"},
+        2.0 * B * T * D, "embed")
 
     prev = "embedding"  # residual-stream carrier entering each layer
     hd = D // H
     for i in range(config.n_layer):
         pre, grp = f"h{i}_", f"layer_{i}"
         ln1 = f"layer_{i}_ln1"
-        add(ln1, partial(f_ln, g=pre + "ln1_g", b=pre + "ln1_b"), [prev],
-            [pre + "ln1_g", pre + "ln1_b"], 5.0 * B * T * D, grp)
+        add(ln1, f_ln, [prev],
+            {"g": pre + "ln1_g", "b": pre + "ln1_b"}, 5.0 * B * T * D, grp)
 
         attn = f"layer_{i}_attention"
         attn_flops = (
@@ -173,36 +179,39 @@ def build_gpt2_dag(
             + 2.0 * 2.0 * B * H * T * T * hd  # scores + probs@v
             + 2.0 * B * T * D * D             # output projection
         )
-        add(attn, partial(f_attn, pre=pre + "attn_"), [ln1],
-            [pre + "attn_qkv_w", pre + "attn_qkv_b",
-             pre + "attn_proj_w", pre + "attn_proj_b"], attn_flops, grp)
+        add(attn, f_attn, [ln1],
+            {"qkv_w": pre + "attn_qkv_w", "qkv_b": pre + "attn_qkv_b",
+             "proj_w": pre + "attn_proj_w", "proj_b": pre + "attn_proj_b"},
+            attn_flops, grp)
 
         attn_res = f"layer_{i}_attn_residual"
-        add(attn_res, f_residual, [prev, attn], [], 1.0 * B * T * D, grp)
+        add(attn_res, f_residual, [prev, attn], {}, 1.0 * B * T * D, grp)
 
         ln2 = f"layer_{i}_ln2"
-        add(ln2, partial(f_ln, g=pre + "ln2_g", b=pre + "ln2_b"), [attn_res],
-            [pre + "ln2_g", pre + "ln2_b"], 5.0 * B * T * D, grp)
+        add(ln2, f_ln, [attn_res],
+            {"g": pre + "ln2_g", "b": pre + "ln2_b"}, 5.0 * B * T * D, grp)
 
         expand = f"layer_{i}_ffn_expand"
-        add(expand, partial(f_ffn_expand, pre=pre + "mlp_"), [ln2],
-            [pre + "mlp_fc_w", pre + "mlp_fc_b"], 2.0 * B * T * D * 4 * D, grp)
+        add(expand, f_ffn_expand, [ln2],
+            {"fc_w": pre + "mlp_fc_w", "fc_b": pre + "mlp_fc_b"},
+            2.0 * B * T * D * 4 * D, grp)
 
         act = f"layer_{i}_ffn_activation"
-        add(act, f_ffn_act, [expand], [], 8.0 * B * T * 4 * D, grp)
+        add(act, f_ffn_act, [expand], {}, 8.0 * B * T * 4 * D, grp)
 
         contract = f"layer_{i}_ffn_contract"
-        add(contract, partial(f_ffn_contract, pre=pre + "mlp_"), [act],
-            [pre + "mlp_proj_w", pre + "mlp_proj_b"], 2.0 * B * T * 4 * D * D, grp)
+        add(contract, f_ffn_contract, [act],
+            {"proj_w": pre + "mlp_proj_w", "proj_b": pre + "mlp_proj_b"},
+            2.0 * B * T * 4 * D * D, grp)
 
         layer_out = f"layer_{i}_output"
-        add(layer_out, f_residual, [attn_res, contract], [], 1.0 * B * T * D, grp)
+        add(layer_out, f_residual, [attn_res, contract], {}, 1.0 * B * T * D, grp)
         prev = layer_out
 
-    add("final_ln", partial(f_ln, g="ln_f_g", b="ln_f_b"), [prev],
-        ["ln_f_g", "ln_f_b"], 5.0 * B * T * D, "head")
+    add("final_ln", f_ln, [prev], {"g": "ln_f_g", "b": "ln_f_b"},
+        5.0 * B * T * D, "head")
     # weight tying: reuses the embedding table (reference test_gpt2.py:160-166)
-    add("output_projection", f_output_projection, ["final_ln"], ["wte"],
+    add("output_projection", f_output_projection, ["final_ln"], {"wte": "wte"},
         2.0 * B * T * D * V, "head")
 
     graph = TaskGraph(tasks, name=f"gpt2_{config.n_layer}l_b{B}_t{T}").freeze()
@@ -227,13 +236,16 @@ def execute_dag_locally(
     Backends replace this with placed, timed execution.
     """
     outputs: Dict[str, Any] = {}
+    jitted: Dict[Any, Any] = {}
     for tid in dag.graph.topo_order:
         task = dag.graph[tid]
-        pd = {p: params[p] for p in task.params_needed}
+        pd = {loc: params[glob] for loc, glob in task.param_items()}
         args = (
             [outputs[d] for d in (task.arg_tasks or task.dependencies)]
             if task.dependencies
             else [input_ids]
         )
-        outputs[tid] = jax.jit(task.fn)(pd, *args)
+        if task.fn not in jitted:
+            jitted[task.fn] = jax.jit(task.fn)
+        outputs[tid] = jitted[task.fn](pd, *args)
     return outputs[dag.graph.topo_order[-1]]

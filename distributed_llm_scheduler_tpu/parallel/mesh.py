@@ -1,0 +1,65 @@
+"""Device mesh construction and axis conventions.
+
+The framework's multi-chip story (new capability — the reference has no
+distributed execution at all, SURVEY.md §2 #25/#26) is standard SPMD over a
+``jax.sharding.Mesh``:
+
+* ``dp``  — data parallel (batch dimension; gradients all-reduced)
+* ``tp``  — tensor parallel (Megatron-style sharded matmuls; activations
+  all-reduced inside each layer)
+* ``sp``  — sequence/context parallel (ring attention over sequence chunks)
+
+Axes are collapsed away when sized 1, so the same code runs single-chip,
+on the CPU-faked 8-device mesh, and on real slices.  XLA inserts the
+collectives (psum/all-gather/reduce-scatter) from sharding annotations; the
+code never issues NCCL-style point-to-point calls — ICI/DCN routing is the
+compiler's job.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh with axes ``("dp", "sp", "tp")`` from available devices.
+
+    ``tp`` is the innermost (fastest-varying) axis so tensor-parallel
+    collectives — the chattiest — ride adjacent cores (shortest ICI hops);
+    ``sp`` ring hops are next; ``dp`` all-reduces tolerate the longest
+    paths.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp * sp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh dp*tp*sp={need} exceeds {len(devices)} available devices"
+        )
+    arr = np.array(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(arr, ("dp", "sp", "tp"))
+
+
+def factorize_mesh(n_devices: int, prefer_tp: int = 4) -> Dict[str, int]:
+    """Pick a reasonable (dp, tp) split for n devices: tp = the largest
+    power-of-two divisor of n up to ``prefer_tp``, dp = the rest."""
+    tp = 1
+    while tp * 2 <= prefer_tp and n_devices % (tp * 2) == 0:
+        tp *= 2
+    return {"dp": n_devices // tp, "tp": tp, "sp": 1}
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))

@@ -1,0 +1,70 @@
+"""Parameter and activation sharding rules for the model families.
+
+Megatron-style tensor parallelism expressed as GSPMD sharding annotations —
+no hand-written collectives.  The forward is written as a *global* program
+(models/gpt2.py); `NamedSharding` placement of params + inputs makes XLA
+partition the matmuls and insert the per-layer all-reduces:
+
+* qkv / mlp-expand weights: column-sharded over ``tp`` (output features);
+* attn-proj / mlp-contract weights: row-sharded over ``tp`` (input
+  features) — their matmul results are partial sums XLA all-reduces;
+* biases follow their weight's output sharding; LN/scalars replicated;
+* embedding table row-(vocab-)sharded over ``tp`` for memory, positions
+  replicated; activations batch-sharded over ``dp`` (and sequence over
+  ``sp`` when ring attention is active).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# parameter-name pattern -> PartitionSpec, checked in order (GPT-2 family
+# naming from models/gpt2.py; llama/mixtral reuse the same suffix scheme)
+GPT2_PARAM_RULES = [
+    # embedding table replicated: GPT-2's vocab (50257) is not divisible by
+    # any tp, and NamedSharding requires even splits.  Memory-sharding the
+    # table needs vocab padding to a tp multiple first — future work.
+    (r"wte$", P()),
+    (r"wpe$", P()),                      # positions replicated
+    (r"attn_qkv_w$", P(None, "tp")),
+    (r"attn_qkv_b$", P("tp")),
+    (r"attn_proj_w$", P("tp", None)),
+    (r"attn_proj_b$", P()),
+    (r"mlp_fc_w$", P(None, "tp")),
+    (r"mlp_fc_b$", P("tp")),
+    (r"mlp_proj_w$", P("tp", None)),
+    (r"mlp_proj_b$", P()),
+    (r"ln.*_[gb]$", P()),
+    (r".*", P()),                        # anything else: replicated
+]
+
+
+def param_spec(name: str) -> P:
+    for pattern, spec in GPT2_PARAM_RULES:
+        if re.search(pattern, name):
+            return spec
+    return P()
+
+
+def param_shardings(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, param_spec(k)) for k in params}
+
+
+def shard_params(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+    """device_put the whole param dict according to the rules."""
+    shardings = param_shardings(mesh, params)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def batch_sharding(mesh: Mesh, seq_parallel: bool = False) -> NamedSharding:
+    """(B, T) token batches: batch over dp, optionally sequence over sp."""
+    return NamedSharding(mesh, P("dp", "sp" if seq_parallel else None))
+
+
+def activation_sharding(mesh: Mesh, seq_parallel: bool = False) -> NamedSharding:
+    """(B, T, D) activations: batch over dp, optionally sequence over sp."""
+    return NamedSharding(mesh, P("dp", "sp" if seq_parallel else None, None))

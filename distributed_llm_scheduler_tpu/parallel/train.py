@@ -1,0 +1,90 @@
+"""Sharded training step: data + tensor (+ sequence) parallel in one jit.
+
+The training-step capability BASELINE.json config #5 asks for, built the
+TPU way: one global program (loss -> grad -> optax update), jitted with
+NamedSharding annotations on params/optimizer state/batch; XLA inserts the
+gradient all-reduce over ``dp`` and the Megatron collectives over ``tp``.
+No parameter server, no NCCL calls — sharding annotations are the entire
+distribution story.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import gpt2
+from ..models.gpt2 import GPT2Config
+from .sharding import batch_sharding, shard_params
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Dict[str, Any]
+    opt_state: Any
+    step: Any
+
+
+def make_train_step(
+    config: GPT2Config,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    seq_parallel: bool = False,
+) -> Tuple[Callable[..., Any], Callable[..., TrainState]]:
+    """Returns ``(train_step, init_state)``.
+
+    ``train_step(state, input_ids, targets) -> (state, loss)`` is jitted
+    with donated state; ``init_state(key)`` materializes sharded params and
+    optimizer state on the mesh.
+    """
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+
+    def loss_fn(params, input_ids, targets):
+        return gpt2.loss_fn(params, input_ids, targets, config)
+
+    def init_state(key: Optional[jax.Array] = None) -> TrainState:
+        key = key if key is not None else jax.random.PRNGKey(0)
+        params = shard_params(mesh, gpt2.init_params(config, key))
+        opt_state = optimizer.init(params)
+        return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    data_sh = batch_sharding(mesh, seq_parallel=seq_parallel)
+
+    def step_fn(state: TrainState, input_ids, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, input_ids, targets
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    jitted = jax.jit(step_fn, in_shardings=(None, data_sh, data_sh), donate_argnums=(0,))
+
+    def train_step(state: TrainState, input_ids, targets):
+        input_ids = jax.device_put(input_ids, data_sh)
+        targets = jax.device_put(targets, data_sh)
+        return jitted(state, input_ids, targets)
+
+    return train_step, init_state
+
+
+def make_eval_step(config: GPT2Config, mesh: Mesh, seq_parallel: bool = False):
+    """Jitted sharded forward (inference step) returning logits."""
+    data_sh = batch_sharding(mesh, seq_parallel=seq_parallel)
+
+    @jax.jit
+    def fwd(params, input_ids):
+        return gpt2.forward(params, input_ids, config)
+
+    def eval_step(params, input_ids):
+        return fwd(params, jax.device_put(input_ids, data_sh))
+
+    return eval_step
