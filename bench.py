@@ -2,13 +2,18 @@
 
 Protocol (BASELINE.md):
 
-1. Build the GPT-2 small (124M) forward DAG (99 tasks, batch 1, seq 512).
+1. Build the GPT-2 small (124M) forward DAG: batch 8 split into 8 pipelined
+   microbatches sharing layer weights (793 tasks) — the placement-sensitive
+   workload.
 2. **Measure** per-task compute times by profile-executing the DAG on the
-   real device (TPU when available) — the measured cost model replaces the
-   analytic seed estimates, so schedulers optimize reality, not fiction.
+   real device (TPU when available; cached in .costmodel/ across reruns) —
+   the measured cost model replaces the analytic seed estimates, so
+   schedulers optimize reality, not fiction.  Sanity: single-chip DAG
+   execution is checked against the fused whole-model forward.
 3. Place the DAG on an 8-core cluster model (v5e-like HBM budgets) with
    every policy; replay under the full-fidelity cost model (dependency
-   waits + ICI/host transfer charges) using the measured times.
+   waits + ICI/host transfer charges + prefetched param loads) using the
+   measured times.
 4. Report makespan of the best policy; ``vs_baseline`` = round-robin
    makespan / best makespan (>= 1.5 is the north-star target).
 
@@ -41,28 +46,43 @@ def main() -> None:
     from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
     from distributed_llm_scheduler_tpu.sched.policies import ALL_SCHEDULERS
 
-    # 1. the flagship DAG
-    dag = build_gpt2_dag(GPT2Config.small(), batch=1, seq_len=512)
+    # 1. the flagship DAG: batch 8 split into 8 pipelined microbatches —
+    # the placement-sensitive workload (layer weights stay resident on a
+    # core while microbatches stream through vs being re-loaded/transferred
+    # per microbatch under naive placement)
+    dag = build_gpt2_dag(GPT2Config.small(), batch=8, seq_len=512, microbatches=8)
     graph = dag.graph
     log(f"bench: built {graph.name}: {len(graph)} tasks, "
         f"{graph.total_param_gb():.2f} GB params")
 
     # 2. measured cost model: profile-execute every task on the real chip
+    # (persisted in .costmodel/ so driver reruns skip re-measurement)
+    from distributed_llm_scheduler_tpu.utils.costmodel import calibrate_cached
+
     params = dag.init_params()
     ids = dag.make_inputs()
+    t0 = time.time()
+    cm = calibrate_cached(graph, params, ids, device=devices[0], repeats=3)
+    cm.apply(graph)
+    log(f"bench: calibration {time.time()-t0:.1f}s on {cm.platform}; "
+        f"per-task total {sum(cm.task_seconds.values())*1e3:.2f} ms, "
+        f"critical path {graph.critical_path_time()*1e3:.2f} ms")
+
+    # end-to-end single-chip execution: warmed makespan + fused-oracle check
+    import numpy as np
+
     one_core = Cluster.from_jax_devices(devices[:1])
     backend = DeviceBackend(one_core)
-    sched_all = get_scheduler("greedy").schedule(graph, one_core)
-    t0 = time.time()
-    rep = backend.execute(graph, sched_all, params, ids, profile=True)
-    log(f"bench: calibration run {time.time()-t0:.1f}s "
-        f"(compile {rep.compile_s:.1f}s), end-to-end chip makespan "
-        f"{rep.makespan_s*1e3:.2f} ms")
-    for tid, t in rep.timings.items():
-        graph[tid].compute_time = max(t.duration, 1e-7)
-    measured_total = sum(t.duration for t in rep.timings.values())
-    log(f"bench: measured per-task total {measured_total*1e3:.2f} ms, "
-        f"critical path {graph.critical_path_time()*1e3:.2f} ms")
+    sched_one = get_scheduler("greedy").schedule(graph, one_core)
+    rep = backend.execute(graph, sched_one, params, ids)  # warmup=True
+    fused = jax.jit(dag.reference_forward)(params, ids)
+    oracle_ok = bool(
+        np.allclose(np.asarray(fused), np.asarray(rep.output), rtol=2e-4, atol=2e-4)
+    )
+    log(f"bench: single-chip DAG makespan {rep.makespan_s*1e3:.2f} ms "
+        f"(post-warmup); matches fused forward: {oracle_ok}")
+    if not oracle_ok:
+        log("bench: ERROR DAG execution diverges from fused forward")
 
     # 3. schedule + replay on an 8-core v5e-like cluster model
     hbm_gb = 14.0  # v5e: 16 GB HBM/core minus runtime reserve

@@ -75,19 +75,31 @@ def build_gpt2_dag(
     config: Optional[GPT2Config] = None,
     batch: int = 1,
     seq_len: int = 512,
+    microbatches: int = 1,
     effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
 ) -> ModelDAG:
     """Build the per-op forward DAG for a GPT-2 config.
 
     Sequence length defaults to 512 like the reference's shape hint
     (test_gpt2.py:53).  Shapes are static; every task fn is traceable.
+
+    ``microbatches > 1`` splits the batch into independent per-microbatch
+    task chains sharing the layer weights, joined by a final concat — the
+    DAG shape of pipeline parallelism.  Good placement keeps each layer's
+    weights resident on one core while microbatches stream through
+    (1F1B-style overlap emerges from list scheduling); naive placement
+    reloads/transfers weights per microbatch.  With ``microbatches=1`` the
+    graph is the reference's 99-task shape exactly.
     """
     config = config or GPT2Config.small()
     if seq_len > config.n_positions:
         raise ValueError(
             f"seq_len {seq_len} exceeds n_positions {config.n_positions}"
         )
+    if batch % microbatches != 0:
+        raise ValueError(f"batch {batch} not divisible by microbatches {microbatches}")
     B, T, D, H, V = batch, seq_len, config.n_embd, config.n_head, config.vocab_size
+    Bm = B // microbatches
     eps = config.ln_eps
 
     specs = {
@@ -135,8 +147,14 @@ def build_gpt2_dag(
         )
 
     # ---- task fns: fn(params_dict, *dep_outputs), local param names ------
-    def f_embedding(p, input_ids):
-        return gpt2.embedding(input_ids, p["wte"], p["wpe"])
+    def make_f_embedding(lo, hi):
+        def f_embedding(p, input_ids):
+            return gpt2.embedding(input_ids[lo:hi], p["wte"], p["wpe"])
+
+        return f_embedding
+
+    def f_concat(p, *chunks):
+        return jnp.concatenate(chunks, axis=0)
 
     def f_ln(p, x):
         return gpt2.layer_norm(x, p["g"], p["b"], eps)
@@ -161,60 +179,75 @@ def build_gpt2_dag(
     def f_output_projection(p, x):
         return gpt2.output_projection(x, p["wte"])
 
-    # ---- graph assembly (8 tasks/layer + 3, reference test_gpt2.py:54-166)
-    add("embedding", f_embedding, [], {"wte": "wte", "wpe": "wpe"},
-        2.0 * B * T * D, "embed")
-
-    prev = "embedding"  # residual-stream carrier entering each layer
+    # ---- graph assembly (8 tasks/layer + 3 per microbatch chain,
+    # reference test_gpt2.py:54-166; mb prefix only when pipelining) -------
     hd = D // H
-    for i in range(config.n_layer):
-        pre, grp = f"h{i}_", f"layer_{i}"
-        ln1 = f"layer_{i}_ln1"
-        add(ln1, f_ln, [prev],
-            {"g": pre + "ln1_g", "b": pre + "ln1_b"}, 5.0 * B * T * D, grp)
+    mb_outputs: List[str] = []
+    for m in range(microbatches):
+        mb = f"mb{m}_" if microbatches > 1 else ""
+        emb = f"{mb}embedding"
+        add(emb, make_f_embedding(m * Bm, (m + 1) * Bm), [],
+            {"wte": "wte", "wpe": "wpe"}, 2.0 * Bm * T * D, "embed")
 
-        attn = f"layer_{i}_attention"
-        attn_flops = (
-            2.0 * B * T * D * 3 * D          # qkv projection
-            + 2.0 * 2.0 * B * H * T * T * hd  # scores + probs@v
-            + 2.0 * B * T * D * D             # output projection
-        )
-        add(attn, f_attn, [ln1],
-            {"qkv_w": pre + "attn_qkv_w", "qkv_b": pre + "attn_qkv_b",
-             "proj_w": pre + "attn_proj_w", "proj_b": pre + "attn_proj_b"},
-            attn_flops, grp)
+        prev = emb  # residual-stream carrier entering each layer
+        for i in range(config.n_layer):
+            pre, grp = f"h{i}_", f"layer_{i}"
+            ln1 = f"{mb}layer_{i}_ln1"
+            add(ln1, f_ln, [prev],
+                {"g": pre + "ln1_g", "b": pre + "ln1_b"}, 5.0 * Bm * T * D, grp)
 
-        attn_res = f"layer_{i}_attn_residual"
-        add(attn_res, f_residual, [prev, attn], {}, 1.0 * B * T * D, grp)
+            attn = f"{mb}layer_{i}_attention"
+            attn_flops = (
+                2.0 * Bm * T * D * 3 * D          # qkv projection
+                + 2.0 * 2.0 * Bm * H * T * T * hd  # scores + probs@v
+                + 2.0 * Bm * T * D * D             # output projection
+            )
+            add(attn, f_attn, [ln1],
+                {"qkv_w": pre + "attn_qkv_w", "qkv_b": pre + "attn_qkv_b",
+                 "proj_w": pre + "attn_proj_w", "proj_b": pre + "attn_proj_b"},
+                attn_flops, grp)
 
-        ln2 = f"layer_{i}_ln2"
-        add(ln2, f_ln, [attn_res],
-            {"g": pre + "ln2_g", "b": pre + "ln2_b"}, 5.0 * B * T * D, grp)
+            attn_res = f"{mb}layer_{i}_attn_residual"
+            add(attn_res, f_residual, [prev, attn], {}, 1.0 * Bm * T * D, grp)
 
-        expand = f"layer_{i}_ffn_expand"
-        add(expand, f_ffn_expand, [ln2],
-            {"fc_w": pre + "mlp_fc_w", "fc_b": pre + "mlp_fc_b"},
-            2.0 * B * T * D * 4 * D, grp)
+            ln2 = f"{mb}layer_{i}_ln2"
+            add(ln2, f_ln, [attn_res],
+                {"g": pre + "ln2_g", "b": pre + "ln2_b"}, 5.0 * Bm * T * D, grp)
 
-        act = f"layer_{i}_ffn_activation"
-        add(act, f_ffn_act, [expand], {}, 8.0 * B * T * 4 * D, grp)
+            expand = f"{mb}layer_{i}_ffn_expand"
+            add(expand, f_ffn_expand, [ln2],
+                {"fc_w": pre + "mlp_fc_w", "fc_b": pre + "mlp_fc_b"},
+                2.0 * Bm * T * D * 4 * D, grp)
 
-        contract = f"layer_{i}_ffn_contract"
-        add(contract, f_ffn_contract, [act],
-            {"proj_w": pre + "mlp_proj_w", "proj_b": pre + "mlp_proj_b"},
-            2.0 * B * T * 4 * D * D, grp)
+            act = f"{mb}layer_{i}_ffn_activation"
+            add(act, f_ffn_act, [expand], {}, 8.0 * Bm * T * 4 * D, grp)
 
-        layer_out = f"layer_{i}_output"
-        add(layer_out, f_residual, [attn_res, contract], {}, 1.0 * B * T * D, grp)
-        prev = layer_out
+            contract = f"{mb}layer_{i}_ffn_contract"
+            add(contract, f_ffn_contract, [act],
+                {"proj_w": pre + "mlp_proj_w", "proj_b": pre + "mlp_proj_b"},
+                2.0 * Bm * T * 4 * D * D, grp)
 
-    add("final_ln", f_ln, [prev], {"g": "ln_f_g", "b": "ln_f_b"},
-        5.0 * B * T * D, "head")
-    # weight tying: reuses the embedding table (reference test_gpt2.py:160-166)
-    add("output_projection", f_output_projection, ["final_ln"], {"wte": "wte"},
-        2.0 * B * T * D * V, "head")
+            layer_out = f"{mb}layer_{i}_output"
+            add(layer_out, f_residual, [attn_res, contract], {},
+                1.0 * Bm * T * D, grp)
+            prev = layer_out
 
-    graph = TaskGraph(tasks, name=f"gpt2_{config.n_layer}l_b{B}_t{T}").freeze()
+        fln = f"{mb}final_ln"
+        add(fln, f_ln, [prev], {"g": "ln_f_g", "b": "ln_f_b"},
+            5.0 * Bm * T * D, "head")
+        # weight tying: reuses the embedding table (test_gpt2.py:160-166)
+        proj = f"{mb}output_projection"
+        add(proj, f_output_projection, [fln], {"wte": "wte"},
+            2.0 * Bm * T * D * V, "head")
+        mb_outputs.append(proj)
+
+    if microbatches > 1:
+        add("output_concat", f_concat, mb_outputs, {}, 1.0 * B * T * V, "head")
+
+    name = f"gpt2_{config.n_layer}l_b{B}_t{T}" + (
+        f"_mb{microbatches}" if microbatches > 1 else ""
+    )
+    graph = TaskGraph(tasks, name=name).freeze()
     return ModelDAG(
         graph=graph,
         config=config,

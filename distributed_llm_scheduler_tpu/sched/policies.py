@@ -1,10 +1,11 @@
-"""The five placement policies.
+"""The policy registry: the reference's four policies plus two new ones.
 
-Four mirror the reference's observed behavior (reference
-``schedulers.py:138-525``); RoundRobin is the new comparator baseline the
-north-star benchmark measures against (BASELINE.md).  All share the
-``_round_loop`` skeleton in :mod:`.base`; each policy only supplies a
-ready-set ordering and a node-picking rule.
+DFS/Greedy/CriticalPath/MRU mirror the reference's observed behavior
+(reference ``schedulers.py:138-525``); RoundRobin is the new comparator
+baseline the north-star benchmark measures against (BASELINE.md); HEFT
+(:mod:`.heft`) is the communication-aware policy built to win it.  The four
+reference policies share the ``_round_loop`` skeleton in :mod:`.base`; each
+supplies only a ready-set ordering and a node-picking rule.
 
 The one deliberate divergence from the reference: MRU's node *scoring* is
 side-effect free here.  The reference performs real evictions while merely
@@ -234,6 +235,8 @@ class MRUScheduler(BaseScheduler):
         self._round_loop(run, order, pick)
 
 
+from .heft import HEFTScheduler  # noqa: E402  (avoids a circular import)
+
 ALL_SCHEDULERS = {
     cls.name: cls
     for cls in (
@@ -242,6 +245,7 @@ ALL_SCHEDULERS = {
         GreedyScheduler,
         CriticalPathScheduler,
         MRUScheduler,
+        HEFTScheduler,
     )
 }
 

@@ -1,0 +1,121 @@
+"""Measured cost model: calibrate task times on real hardware, persist, apply.
+
+Replaces the reference's class-based compute-time constants
+(reference ``test_gpt2.py:33-43``) with measured compiled timings
+(SURVEY.md §7 step 6): profile-execute the DAG once on a device, record
+per-task wall times, and feed them back into ``Task.compute_time`` so
+policies (HEFT/critical-path especially) optimize reality.  Calibrations
+persist to JSON keyed by graph name + platform so reruns skip measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..core.graph import TaskGraph
+
+
+@dataclass
+class CostModel:
+    """task_id -> measured seconds, plus provenance."""
+
+    graph_name: str
+    platform: str
+    task_seconds: Dict[str, float] = field(default_factory=dict)
+
+    def apply(self, graph: TaskGraph) -> int:
+        """Overwrite compute_time for tasks present in the model.
+
+        Returns how many tasks were updated.  Unknown tasks keep their
+        analytic seed estimate.
+        """
+        n = 0
+        for tid, secs in self.task_seconds.items():
+            t = graph.get(tid)
+            if t is not None:
+                t.compute_time = max(secs, 1e-7)
+                n += 1
+        return n
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "graph_name": self.graph_name,
+                    "platform": self.platform,
+                    "task_seconds": self.task_seconds,
+                },
+                f,
+                indent=1,
+            )
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "CostModel":
+        with open(path) as f:
+            d = json.load(f)
+        return cls(d["graph_name"], d["platform"], d["task_seconds"])
+
+
+def calibrate(
+    graph: TaskGraph,
+    params: Dict[str, Any],
+    graph_input: Any,
+    device: Optional[Any] = None,
+    repeats: int = 3,
+) -> CostModel:
+    """Measure per-task times by profile-executing on one device.
+
+    Times the whole DAG ``repeats`` times after a compile warmup and keeps
+    the per-task minimum (least-interference estimate).
+    """
+    import jax
+
+    from ..backends.device import DeviceBackend
+    from ..core.cluster import Cluster
+    from ..sched.policies import get_scheduler
+
+    device = device if device is not None else jax.devices()[0]
+    cluster = Cluster.from_jax_devices([device])
+    backend = DeviceBackend(cluster)
+    schedule = get_scheduler("greedy").schedule(graph, cluster)
+
+    best: Dict[str, float] = {}
+    # first execute() warms the jit caches; profile repeats take minima
+    backend.execute(graph, schedule, params, graph_input, warmup=True)
+    for _ in range(repeats):
+        rep = backend.execute(
+            graph, schedule, params, graph_input, profile=True, warmup=False
+        )
+        for tid, t in rep.timings.items():
+            dur = t.duration
+            if tid not in best or dur < best[tid]:
+                best[tid] = dur
+    return CostModel(graph.name, device.platform, best)
+
+
+def calibrate_cached(
+    graph: TaskGraph,
+    params: Dict[str, Any],
+    graph_input: Any,
+    cache_dir: str = ".costmodel",
+    device: Optional[Any] = None,
+    repeats: int = 3,
+) -> CostModel:
+    """Calibrate, or load a previous calibration for this graph+platform."""
+    import jax
+
+    device = device if device is not None else jax.devices()[0]
+    path = os.path.join(cache_dir, f"{graph.name}_{device.platform}.json")
+    if os.path.exists(path):
+        cm = CostModel.load(path)
+        if set(cm.task_seconds) == set(graph.task_ids()):
+            return cm
+    cm = calibrate(graph, params, graph_input, device=device, repeats=repeats)
+    cm.save(path)
+    return cm

@@ -21,8 +21,8 @@ def small_sweep():
 
 
 def test_sweep_produces_all_trials(small_sweep):
-    # 1 workload x 2 node counts x 2 regimes x 2 runs x 5 schedulers
-    assert len(small_sweep.reports) == 1 * 2 * 2 * 2 * 5
+    # 1 workload x 2 node counts x 2 regimes x 2 runs x 6 schedulers
+    assert len(small_sweep.reports) == 1 * 2 * 2 * 2 * 6
 
 
 def test_mru_headline_behavior(small_sweep):
@@ -53,7 +53,7 @@ def test_csv_and_plots_written(small_sweep, tmp_path):
 
 def test_summary_fields(small_sweep):
     s = small_sweep.summarize()
-    assert set(s["mean_metrics"]) == {"critical", "dfs", "greedy", "mru", "roundrobin"}
+    assert set(s["mean_metrics"]) == {"critical", "dfs", "greedy", "heft", "mru", "roundrobin"}
     assert s["best_completion"] in s["mean_metrics"]
     assert "llm_cache_hit_rate" in s
     small_sweep.print_summary()

@@ -153,3 +153,46 @@ def test_tracer_tracks_params_through_trivial_ops():
     assert g.total_param_gb() > 0
     (task,) = [t for t in g if "dot_general" in t.task_id]
     assert task.params_needed  # the transposed const reaches the matmul
+
+
+def test_microbatched_dag_matches_fused_forward():
+    """Pipelined (4-microbatch) DAG execution == fused full-batch forward."""
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=8, seq_len=16, microbatches=4)
+    assert len(dag.graph) == 4 * (8 * 2 + 3) + 1
+    params = dag.init_params()
+    ids = dag.make_inputs()
+    fused = dag.reference_forward(params, ids)
+    via_dag = execute_dag_locally(dag, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(via_dag), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_microbatch_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        build_gpt2_dag(GPT2Config.tiny(), batch=3, seq_len=16, microbatches=2)
+
+
+def test_costmodel_roundtrip(tmp_path, tiny_dag):
+    """Calibration persists and reloads identically; cache hit skips
+    re-measurement."""
+    from distributed_llm_scheduler_tpu.utils.costmodel import (
+        CostModel,
+        calibrate_cached,
+    )
+
+    params = tiny_dag.init_params()
+    ids = tiny_dag.make_inputs()
+    cm1 = calibrate_cached(
+        tiny_dag.graph, params, ids, cache_dir=str(tmp_path), repeats=1
+    )
+    cm2 = calibrate_cached(
+        tiny_dag.graph, params, ids, cache_dir=str(tmp_path), repeats=1
+    )
+    assert cm1.task_seconds == cm2.task_seconds  # second call = cache hit
+    assert set(cm1.task_seconds) == set(tiny_dag.graph.task_ids())
+    assert cm1.apply(tiny_dag.graph) == len(tiny_dag.graph)
+    loaded = CostModel.load(
+        str(tmp_path / f"{tiny_dag.graph.name}_cpu.json")
+    )
+    assert loaded.task_seconds == cm1.task_seconds
