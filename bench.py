@@ -91,9 +91,13 @@ def main() -> None:
     link = LinkModel(param_load_gbps=20.0, interconnect_gbps=100.0, latency_s=5e-6)
     sim = SimulatedBackend(fidelity="full", link=link)
 
+    from distributed_llm_scheduler_tpu.sched.heft import HEFTScheduler
+
     makespans = {}
     for name in sorted(ALL_SCHEDULERS):
-        s = get_scheduler(name).schedule(graph, cluster)
+        # HEFT optimizes the replay's objective: hand it the same link model
+        sched = HEFTScheduler(link=link) if name == "heft" else get_scheduler(name)
+        s = sched.schedule(graph, cluster)
         r = sim.execute(graph, cluster, s, dag_type="gpt2_small")
         completion = r.completed_tasks / r.num_tasks
         makespans[name] = (r.makespan, completion)

@@ -1,0 +1,223 @@
+"""CLI: ``python -m distributed_llm_scheduler_tpu <command>``.
+
+Replaces the reference's four bare ``python <file>.py`` entry points
+(reference README.md:16-59 — no flags anywhere) with one CLI:
+
+* ``schedule``  — build a DAG, place it with a policy, report + save
+* ``sweep``     — the full evaluation sweep (CSV + PNG + summary)
+* ``execute``   — run a scheduled model DAG on live JAX devices
+* ``visualize`` — DAG structure and Gantt renderings
+* ``train``     — a few sharded (dp x tp) training steps
+* ``bench``     — the north-star benchmark (one JSON line)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--model", default="gpt2",
+                   help="gpt2 | gpt2-medium | gpt2-tiny | llm | random | pipeline")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--microbatches", type=int, default=1)
+    p.add_argument("--num-layers", type=int, default=None)
+    p.add_argument("--num-nodes", type=int, default=8)
+    p.add_argument("--hbm-gb", type=float, default=14.0)
+    p.add_argument("--memory-regime", type=float, default=1.0)
+    p.add_argument("--scheduler", default="heft")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out-dir", default="evaluation_results")
+
+
+def _config_from(args: argparse.Namespace):
+    from .utils.config import RunConfig
+
+    fields = {f.name for f in dataclasses.fields(RunConfig)}
+    kw = {k: v for k, v in vars(args).items() if k in fields and v is not None}
+    return RunConfig(**kw)
+
+
+def cmd_schedule(args) -> int:
+    from .backends.sim import SimulatedBackend
+    from .sched.policies import get_scheduler
+    from .utils.serialization import save_graph, save_schedule
+
+    cfg = _config_from(args)
+    dag = cfg.build_graph()
+    graph = getattr(dag, "graph", dag)
+    cluster = cfg.build_cluster()
+    sched = get_scheduler(cfg.scheduler)
+    schedule = sched.schedule(graph, cluster)
+    rep = SimulatedBackend(fidelity="full").execute(
+        graph, cluster, schedule, dag_type=cfg.model
+    )
+    print(json.dumps({
+        "graph": graph.summary(),
+        "schedule": {k: v for k, v in schedule.summary().items()},
+        "makespan_s": rep.makespan,
+        "cache_hit_rate": rep.cache_hit_rate,
+        "load_balance": rep.load_balance_score,
+    }, indent=1, default=str))
+    if args.save:
+        print("graph ->", save_graph(graph, f"{cfg.out_dir}/{graph.name}.graph.json"))
+        print("schedule ->", save_schedule(
+            schedule, f"{cfg.out_dir}/{graph.name}.{cfg.scheduler}.schedule.json"
+        ))
+    return 0
+
+
+def cmd_sweep(args) -> int:
+    from .eval.evaluator import Evaluator
+
+    cfg = _config_from(args)
+    ev = Evaluator(
+        node_counts=cfg.node_counts,
+        memory_regimes=cfg.memory_regimes,
+    )
+    ev.run_experiments(num_runs=args.num_runs, seed=cfg.seed)
+    print("csv ->", ev.write_csv(f"{cfg.out_dir}/raw_results.csv"))
+    print("png ->", ev.write_plots(f"{cfg.out_dir}/scheduler_performance.png"))
+    ev.print_summary()
+    return 0
+
+
+def cmd_execute(args) -> int:
+    from .backends.device import DeviceBackend
+    from .sched.policies import get_scheduler
+
+    cfg = _config_from(args)
+    dag = cfg.build_graph()
+    if not hasattr(dag, "graph"):
+        print("execute needs a model DAG (gpt2*); synthetic graphs have no fns",
+              file=sys.stderr)
+        return 2
+    cluster = cfg.build_cluster_with_devices()
+    schedule = get_scheduler(cfg.scheduler).schedule(dag.graph, cluster)
+    backend = DeviceBackend(cluster)
+    params = dag.init_params()
+    ids = dag.make_inputs()
+    rep = backend.execute(dag.graph, schedule, params, ids, profile=args.profile)
+    print(json.dumps(rep.summary(), indent=1, default=str))
+    return 0
+
+
+def cmd_visualize(args) -> int:
+    from .backends.sim import SimulatedBackend
+    from .sched.policies import get_scheduler
+    from .visu.plots import visualize_dag, visualize_schedule
+
+    cfg = _config_from(args)
+    dag = cfg.build_graph()
+    graph = getattr(dag, "graph", dag)
+    print("dag ->", visualize_dag(
+        graph, f"{cfg.out_dir}/{graph.name}.dag.png", detailed=args.detailed
+    ))
+    cluster = cfg.build_cluster()
+    schedule = get_scheduler(cfg.scheduler).schedule(graph, cluster)
+    SimulatedBackend(fidelity="full").execute(graph, cluster, schedule)
+    print("gantt ->", visualize_schedule(
+        schedule, f"{cfg.out_dir}/{graph.name}.{cfg.scheduler}.gantt.png"
+    ))
+    return 0
+
+
+def cmd_train(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from .models.gpt2 import GPT2Config
+    from .parallel.mesh import factorize_mesh, make_mesh
+    from .parallel.train import make_train_step
+
+    cfg_map = {"gpt2": GPT2Config.small, "gpt2-medium": GPT2Config.medium,
+               "gpt2-tiny": GPT2Config.tiny}
+    mcfg = cfg_map.get(args.model, GPT2Config.tiny)()
+    axes = factorize_mesh(len(jax.devices()))
+    mesh = make_mesh(**axes)
+    train_step, init_state = make_train_step(mcfg, mesh)
+    state = init_state(jax.random.PRNGKey(args.seed))
+    batch = max(2 * axes["dp"], 2)
+    seq = min(args.seq_len, mcfg.n_positions)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, mcfg.vocab_size, dtype=jnp.int32
+    )
+    targets = jnp.roll(ids, -1, axis=1)
+    for step in range(args.steps):
+        state, loss = train_step(state, ids, targets)
+        print(f"step {int(state.step)}: loss {float(loss):.4f}")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import importlib.util
+    import os
+
+    path = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    if not os.path.exists(path):
+        print("bench.py not found (the benchmark runs from a source "
+              "checkout, not an installed package)", file=sys.stderr)
+        return 2
+    spec = importlib.util.spec_from_file_location("bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    import os
+
+    if os.environ.get("DLS_FORCE_CPU"):
+        # must happen before any backend init; the site-installed TPU plugin
+        # otherwise claims the backend even when JAX_PLATFORMS=cpu is set
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    ap = argparse.ArgumentParser(
+        prog="distributed_llm_scheduler_tpu",
+        description="TPU-native memory-constrained DAG scheduling for LLMs",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("schedule", help="place a DAG and report metrics")
+    _add_common(p)
+    p.add_argument("--save", action="store_true", help="save graph+schedule JSON")
+    p.set_defaults(fn=cmd_schedule)
+
+    p = sub.add_parser("sweep", help="full evaluation sweep (CSV+PNG)")
+    _add_common(p)
+    p.add_argument("--num-runs", type=int, default=3)
+    p.set_defaults(fn=cmd_sweep)
+
+    p = sub.add_parser("execute", help="run a scheduled DAG on live devices")
+    _add_common(p)
+    p.add_argument("--profile", action="store_true")
+    p.set_defaults(fn=cmd_execute)
+
+    p = sub.add_parser("visualize", help="render DAG + Gantt PNGs")
+    _add_common(p)
+    p.add_argument("--detailed", action="store_true")
+    p.set_defaults(fn=cmd_visualize)
+
+    p = sub.add_parser("train", help="run sharded training steps")
+    _add_common(p)
+    p.add_argument("--steps", type=int, default=3)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")
+    p.set_defaults(fn=cmd_bench)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,7 +244,10 @@ def build_gpt2_dag(
     if microbatches > 1:
         add("output_concat", f_concat, mb_outputs, {}, 1.0 * B * T * V, "head")
 
-    name = f"gpt2_{config.n_layer}l_b{B}_t{T}" + (
+    # name encodes width too: cost-model caches key on graph name, and two
+    # configs with equal layer/batch/seq but different widths must not
+    # share measured timings
+    name = f"gpt2_{config.n_layer}l_d{D}_b{B}_t{T}" + (
         f"_mb{microbatches}" if microbatches > 1 else ""
     )
     graph = TaskGraph(tasks, name=name).freeze()

@@ -47,9 +47,9 @@ class HEFTScheduler(BaseScheduler):
         for tid in reversed(graph.topo_order):
             task = graph[tid]
             w = task.compute_time / mean_speed
+            comm = cross_frac * self.link.transfer_time(task.memory_required)
             best_child = 0.0
             for c in graph.dependents(tid):
-                comm = cross_frac * self.link.transfer_time(task.memory_required)
                 best_child = max(best_child, comm + rank[c])
             rank[tid] = w + best_child
 

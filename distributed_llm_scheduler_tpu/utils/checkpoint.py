@@ -1,0 +1,47 @@
+"""Parameter/training-state checkpointing.
+
+The reference has no execution checkpointing (SURVEY.md §5.4 — only the
+pickled DAG artifact).  Here: Orbax for param pytrees when available
+(sharding-aware, async-capable — the TPU-native answer), with a plain
+``numpy .npz`` fallback so checkpointing never depends on Orbax API churn.
+Resume = load params + re-place (schedules are cheap to recompute and are
+serialized separately via utils.serialization).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+
+def save_params(params: Dict[str, Any], path: str, use_orbax: Optional[bool] = None) -> str:
+    """Save a flat param dict.  ``path`` is a directory for orbax, a ``.npz``
+    file for the numpy fallback."""
+    if use_orbax is None:
+        use_orbax = not path.endswith(".npz")
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, params, force=True)
+        return path
+    import numpy as np
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **{k: np.asarray(v) for k, v in params.items()})
+    return path
+
+
+def load_params(path: str, use_orbax: Optional[bool] = None) -> Dict[str, Any]:
+    if use_orbax is None:
+        use_orbax = not path.endswith(".npz")
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        return ckptr.restore(os.path.abspath(path))
+    import numpy as np
+
+    with np.load(path) as f:
+        return {k: f[k] for k in f.files}

@@ -1,0 +1,108 @@
+"""Run configuration.
+
+The reference hardcodes every constant (SURVEY.md §5.6: param size, memory
+regimes, node profiles, model name — zero argparse anywhere).  Here a
+dataclass carries the whole experiment description and maps 1:1 onto the
+CLI flags in ``__main__``; everything has a default so ``python -m
+distributed_llm_scheduler_tpu <cmd>`` just works.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class RunConfig:
+    # workload
+    model: str = "gpt2"            # gpt2 | gpt2-medium | gpt2-tiny | llm | random | pipeline
+    batch: int = 1
+    seq_len: int = 512
+    microbatches: int = 1
+    num_layers: Optional[int] = None  # synthetic workloads / overrides
+
+    # cluster
+    num_nodes: int = 8
+    hbm_gb: float = 14.0
+    memory_regime: float = 1.0
+    use_jax_devices: bool = False  # bind live devices (device backend)
+
+    # scheduling
+    scheduler: str = "heft"
+
+    # backend
+    backend: str = "sim"           # sim | sim-reference | device
+    prefetch_params: bool = True
+
+    # evaluation sweep
+    num_runs: int = 3
+    node_counts: Tuple[int, ...] = (2, 4, 8)
+    memory_regimes: Tuple[float, ...] = (1.0, 0.9, 0.8)
+
+    # io
+    out_dir: str = "evaluation_results"
+    seed: int = 0
+
+    def build_graph(self):
+        from ..frontend import generators
+        from ..frontend.gpt2_dag import build_gpt2_dag
+        from ..models.gpt2 import GPT2Config
+
+        if self.model.startswith("gpt2"):
+            cfg = {
+                "gpt2": GPT2Config.small,
+                "gpt2-medium": GPT2Config.medium,
+                "gpt2-tiny": GPT2Config.tiny,
+            }[self.model]()
+            if self.num_layers:
+                cfg = dataclasses.replace(cfg, n_layer=self.num_layers)
+            seq = min(self.seq_len, cfg.n_positions)
+            return build_gpt2_dag(
+                cfg, batch=self.batch, seq_len=seq,
+                microbatches=self.microbatches,
+            )
+        makers = {
+            "llm": lambda: generators.generate_llm_dag(
+                num_layers=self.num_layers or 4, seed=self.seed
+            ),
+            "random": lambda: generators.generate_random_dag(
+                num_tasks=(self.num_layers or 4) * 8, seed=self.seed
+            ),
+            "pipeline": lambda: generators.generate_pipeline_dag(
+                num_stages=self.num_layers or 4, seed=self.seed
+            ),
+        }
+        if self.model not in makers:
+            raise ValueError(
+                f"unknown model {self.model!r}; choose gpt2 / gpt2-medium / "
+                "gpt2-tiny / llm / random / pipeline"
+            )
+        return makers[self.model]()
+
+    def build_cluster(self):
+        from ..core.cluster import Cluster
+
+        if self.use_jax_devices:
+            return Cluster.from_jax_devices(hbm_cap_gb=self.hbm_gb)
+        return Cluster.uniform(self.num_nodes, self.hbm_gb * self.memory_regime)
+
+    def build_backend(self):
+        from ..backends.sim import SimulatedBackend
+
+        if self.backend == "sim":
+            return SimulatedBackend(
+                fidelity="full", prefetch_params=self.prefetch_params
+            )
+        if self.backend == "sim-reference":
+            return SimulatedBackend(fidelity="reference")
+        if self.backend == "device":
+            from ..backends.device import DeviceBackend
+
+            return DeviceBackend(self.build_cluster_with_devices())
+        raise ValueError(f"unknown backend {self.backend!r}")
+
+    def build_cluster_with_devices(self):
+        from ..core.cluster import Cluster
+
+        return Cluster.from_jax_devices(hbm_cap_gb=self.hbm_gb)

@@ -1,0 +1,66 @@
+"""Profiling helpers: XLA traces and wall timers.
+
+The reference's only tracing is ``time.time()`` around ``schedule()``
+(reference ``simulation.py:327-333``).  TPU equivalents (SURVEY.md §5.1):
+``jax.profiler`` traces viewable in TensorBoard/Perfetto, plus
+``cost_analysis`` on compiled executables to read XLA's own FLOP estimates.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: str = "/tmp/jax-trace") -> Iterator[None]:
+    """Capture a jax.profiler trace around a block (open in TensorBoard)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def wall_timer() -> Iterator[Dict[str, float]]:
+    """``with wall_timer() as t: ...; t['seconds']``"""
+    out: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    try:
+        yield out
+    finally:
+        out["seconds"] = time.perf_counter() - t0
+
+
+def compiled_cost_analysis(fn: Callable[..., Any], *example_args: Any) -> Dict[str, float]:
+    """XLA's cost analysis (flops, bytes accessed) for ``fn`` on the example
+    shapes — the compiler-side complement to measured timings."""
+    import jax
+
+    lowered = jax.jit(fn).lower(*example_args)
+    compiled = lowered.compile()
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(analysis, list):  # per-device list on older APIs
+        analysis = analysis[0] if analysis else {}
+    return {k: float(v) for k, v in dict(analysis).items()
+            if isinstance(v, (int, float))}
+
+
+def time_fn(fn: Callable[..., Any], *args: Any, repeats: int = 5) -> float:
+    """Best-of-N wall time of a jitted call (blocks on the result)."""
+    import jax
+
+    fn(*args)  # warmup/compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
