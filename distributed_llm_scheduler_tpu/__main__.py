@@ -21,7 +21,10 @@ import sys
 
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--model", default="gpt2",
-                   help="gpt2 | gpt2-medium | gpt2-tiny | llm | random | pipeline")
+                   help="gpt2 | gpt2-medium | gpt2-tiny | llama | llama-8b | "
+                        "llama-tiny | llm | random | pipeline")
+    p.add_argument("--backend", default="sim",
+                   help="sim | sim-reference (replay fidelity for schedule/visualize)")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--microbatches", type=int, default=1)
@@ -42,8 +45,19 @@ def _config_from(args: argparse.Namespace):
     return RunConfig(**kw)
 
 
+def _replay_backend(cfg):
+    """The sim backend the schedule/visualize replay commands accept; the
+    device backend has a different execute() contract (live params/inputs)
+    and is driven by the ``execute`` command instead."""
+    if cfg.backend not in ("sim", "sim-reference"):
+        raise SystemExit(
+            f"--backend {cfg.backend!r} is not valid here; schedule/visualize "
+            "replay with sim | sim-reference (run live devices via `execute`)"
+        )
+    return cfg.build_backend()
+
+
 def cmd_schedule(args) -> int:
-    from .backends.sim import SimulatedBackend
     from .sched.policies import get_scheduler
     from .utils.serialization import save_graph, save_schedule
 
@@ -53,7 +67,7 @@ def cmd_schedule(args) -> int:
     cluster = cfg.build_cluster()
     sched = get_scheduler(cfg.scheduler)
     schedule = sched.schedule(graph, cluster)
-    rep = SimulatedBackend(fidelity="full").execute(
+    rep = _replay_backend(cfg).execute(
         graph, cluster, schedule, dag_type=cfg.model
     )
     print(json.dumps({
@@ -93,8 +107,8 @@ def cmd_execute(args) -> int:
     cfg = _config_from(args)
     dag = cfg.build_graph()
     if not hasattr(dag, "graph"):
-        print("execute needs a model DAG (gpt2*); synthetic graphs have no fns",
-              file=sys.stderr)
+        print("execute needs a model DAG (gpt2* or llama*); synthetic graphs "
+              "have no fns", file=sys.stderr)
         return 2
     cluster = cfg.build_cluster_with_devices()
     schedule = get_scheduler(cfg.scheduler).schedule(dag.graph, cluster)
@@ -107,7 +121,6 @@ def cmd_execute(args) -> int:
 
 
 def cmd_visualize(args) -> int:
-    from .backends.sim import SimulatedBackend
     from .sched.policies import get_scheduler
     from .visu.plots import visualize_dag, visualize_schedule
 
@@ -119,7 +132,7 @@ def cmd_visualize(args) -> int:
     ))
     cluster = cfg.build_cluster()
     schedule = get_scheduler(cfg.scheduler).schedule(graph, cluster)
-    SimulatedBackend(fidelity="full").execute(graph, cluster, schedule)
+    _replay_backend(cfg).execute(graph, cluster, schedule)
     print("gantt ->", visualize_schedule(
         schedule, f"{cfg.out_dir}/{graph.name}.{cfg.scheduler}.gantt.png"
     ))

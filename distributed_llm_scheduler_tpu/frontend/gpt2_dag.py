@@ -40,19 +40,31 @@ DEFAULT_EFFECTIVE_FLOPS = 2.0e12
 
 @dataclasses.dataclass
 class ModelDAG:
-    """A task graph plus everything needed to actually run it."""
+    """A task graph plus everything needed to actually run it.
+
+    Shared by every model-family frontend (GPT-2 here, Llama in
+    ``llama_dag.py``, Mixtral in ``moe_dag.py``); ``config`` is the family's
+    own config dataclass and ``init_fn`` its param initializer (defaults to
+    GPT-2's for backward compatibility).
+    """
 
     graph: TaskGraph
-    config: GPT2Config
+    config: Any
     input_spec: jax.ShapeDtypeStruct
     # param name -> ShapeDtypeStruct; materialize with init_params()
     param_specs: Dict[str, Any]
     # the fused single-program oracle: forward(params, input_ids)
     reference_forward: Callable[..., Any]
+    # key -> flat params dict for this family's config
+    init_fn: Callable[[Any], Dict[str, Any]] = None  # type: ignore[assignment]
 
     def init_params(self, key: Optional[jax.Array] = None) -> Dict[str, Any]:
         key = key if key is not None else jax.random.PRNGKey(0)
-        return gpt2.init_params(self.config, key)
+        if self.init_fn is None:
+            raise ValueError(
+                "ModelDAG has no init_fn; the family's builder must supply one"
+            )
+        return self.init_fn(key)
 
     def make_inputs(self, key: Optional[jax.Array] = None) -> jax.Array:
         key = key if key is not None else jax.random.PRNGKey(1)
@@ -256,9 +268,8 @@ def build_gpt2_dag(
         config=config,
         input_spec=input_spec,
         param_specs=specs,
-        reference_forward=partial(
-            lambda p, ids, cfg: gpt2.forward(p, ids, cfg), cfg=config
-        ),
+        reference_forward=partial(gpt2.forward, config=config),
+        init_fn=lambda key: gpt2.init_params(config, key),
     )
 
 

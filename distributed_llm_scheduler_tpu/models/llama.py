@@ -1,0 +1,235 @@
+"""Llama-3 in pure JAX: second model family (BASELINE.json config #3).
+
+Same design as :mod:`.gpt2` — functional, flat ``Dict[str, jax.Array]``
+params whose names are shared with the DAG frontend's ``params_needed``
+vocabulary — but the Llama architecture: RMSNorm (no biases), rotary
+position embeddings (no learned position table), grouped-query attention
+(n_kv_heads < n_heads), SwiGLU FFN, untied LM head.
+
+The reference never models Llama (its extractor is GPT-2-only, reference
+``test_gpt2.py:45-168``); this family exists because the rebuild's baseline
+configs call for "Llama-3 8B layer-wise DAG, pipeline-stage scheduling
+across v5e-16".  Per-op functions are individually jittable so the DAG
+frontend (``frontend/llama_dag.py``) wraps them as task fns; ``forward``
+is the fused oracle.
+
+TPU notes: all matmuls run in the model dtype (bfloat16 on TPU) for the
+MXU; RMSNorm and softmax accumulate in float32.  RoPE tables are computed
+inside the jitted fn from static shapes — XLA constant-folds them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    max_seq_len: int = 8192
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14_336
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        """Llama-3 8B (8.03B params): the config #3 target."""
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-sized: 2 layers, 128 wide, GQA 4:2 — CPU-fast, same topology."""
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("d_model", 128)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("n_kv_heads", 2)
+        kw.setdefault("ffn_hidden", 256)
+        kw.setdefault("rope_theta", 10_000.0)
+        return cls(**kw)
+
+
+# -- parameter init ---------------------------------------------------------
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, jax.Array]:
+    """Flat naming scheme shared with the DAG frontend:
+    ``tok_emb, l{i}_attn_norm_g, l{i}_wq/wk/wv/wo, l{i}_ffn_norm_g,
+    l{i}_w_gate/w_up/w_down, final_norm_g, lm_head``."""
+    std = 0.02
+    d, dtype = config.d_model, config.dtype
+    hd, nh, nkv, f = config.head_dim, config.n_heads, config.n_kv_heads, config.ffn_hidden
+    params: Dict[str, jax.Array] = {}
+
+    def normal(key, shape, scale=std):
+        return (scale * jax.random.normal(key, shape)).astype(dtype)
+
+    keys = iter(jax.random.split(key, 2 + config.n_layers * 7))
+    params["tok_emb"] = normal(next(keys), (config.vocab_size, d))
+    out_scale = std / math.sqrt(2 * config.n_layers)
+    for i in range(config.n_layers):
+        p = f"l{i}_"
+        params[p + "attn_norm_g"] = jnp.ones((d,), dtype)
+        params[p + "wq"] = normal(next(keys), (d, nh * hd))
+        params[p + "wk"] = normal(next(keys), (d, nkv * hd))
+        params[p + "wv"] = normal(next(keys), (d, nkv * hd))
+        params[p + "wo"] = normal(next(keys), (nh * hd, d), out_scale)
+        params[p + "ffn_norm_g"] = jnp.ones((d,), dtype)
+        params[p + "w_gate"] = normal(next(keys), (d, f))
+        params[p + "w_up"] = normal(next(keys), (d, f))
+        params[p + "w_down"] = normal(next(keys), (f, d), out_scale)
+    params["final_norm_g"] = jnp.ones((d,), dtype)
+    params["lm_head"] = normal(next(keys), (d, config.vocab_size))
+    return params
+
+
+def param_shapes(config: LlamaConfig) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+    shaped = jax.eval_shape(
+        lambda k: init_params(config, k), jax.ShapeDtypeStruct((2,), jnp.uint32)
+    )
+    return {k: (v.shape, v.dtype) for k, v in shaped.items()}
+
+
+def num_params(config: LlamaConfig) -> int:
+    return sum(math.prod(shape) for shape, _ in param_shapes(config).values())
+
+
+# -- per-op functions (DAG task granularity) --------------------------------
+
+def rms_norm(x: jax.Array, g: jax.Array, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return (xf * scale * g.astype(jnp.float32)).astype(x.dtype)
+
+
+def embedding(input_ids: jax.Array, tok_emb: jax.Array) -> jax.Array:
+    return tok_emb[input_ids]
+
+
+def rope_tables(T: int, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """(cos, sin) of shape (T, head_dim//2), float32.  Static-shape; XLA
+    constant-folds these when they appear inside a jitted task fn."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    ang = jnp.arange(T, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, H, T, hd) with interleaved (even, odd) rotation pairs."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    r1 = xf1 * cos - xf2 * sin
+    r2 = xf1 * sin + xf2 * cos
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def gqa_attention(
+    x: jax.Array,
+    wq: jax.Array,
+    wk: jax.Array,
+    wv: jax.Array,
+    wo: jax.Array,
+    n_heads: int,
+    n_kv_heads: int,
+    rope_theta: float,
+) -> jax.Array:
+    """Causal grouped-query attention with RoPE, incl. output projection —
+    one task, matching the per-layer "attention" granularity of the GPT-2
+    DAG (reference test_gpt2.py:75-90 puts qkv+proj on a single task)."""
+    B, T, D = x.shape
+    hd = wq.shape[-1] // n_heads
+    group = n_heads // n_kv_heads
+
+    q = (x @ wq).reshape(B, T, n_heads, hd).transpose(0, 2, 1, 3)
+    k = (x @ wk).reshape(B, T, n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = (x @ wv).reshape(B, T, n_kv_heads, hd).transpose(0, 2, 1, 3)
+
+    cos, sin = rope_tables(T, hd, rope_theta)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    # broadcast KV heads across their query group (GQA): (B, nkv, T, hd) ->
+    # (B, nkv, group, T, hd); einsum contracts per (kv-head, group) pair
+    qg = q.reshape(B, n_kv_heads, group, T, hd)
+    scores = jnp.einsum("bkgqd,bkld->bkgql", qg, k) / math.sqrt(hd)
+    i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+    scores = jnp.where(j <= i, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bkgql,bkld->bkgqd", probs, v)
+    out = out.reshape(B, n_heads, T, hd).transpose(0, 2, 1, 3).reshape(B, T, D)
+    return out @ wo
+
+
+def ffn_gate(x: jax.Array, w_gate: jax.Array) -> jax.Array:
+    return x @ w_gate
+
+
+def ffn_up(x: jax.Array, w_up: jax.Array) -> jax.Array:
+    return x @ w_up
+
+
+def ffn_glu(gate: jax.Array, up: jax.Array) -> jax.Array:
+    return jax.nn.silu(gate) * up
+
+
+def ffn_down(x: jax.Array, w_down: jax.Array) -> jax.Array:
+    return x @ w_down
+
+
+def residual_add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a + b
+
+
+def lm_head(x: jax.Array, w: jax.Array) -> jax.Array:
+    return x @ w
+
+
+# -- whole-model forward (fused baseline + correctness oracle) --------------
+
+def forward(
+    params: Dict[str, jax.Array], input_ids: jax.Array, config: LlamaConfig
+) -> jax.Array:
+    x = embedding(input_ids, params["tok_emb"])
+    for i in range(config.n_layers):
+        p = f"l{i}_"
+        h = rms_norm(x, params[p + "attn_norm_g"], config.rms_eps)
+        h = gqa_attention(
+            h, params[p + "wq"], params[p + "wk"], params[p + "wv"],
+            params[p + "wo"], config.n_heads, config.n_kv_heads, config.rope_theta,
+        )
+        x = residual_add(x, h)
+        h = rms_norm(x, params[p + "ffn_norm_g"], config.rms_eps)
+        g = ffn_gate(h, params[p + "w_gate"])
+        u = ffn_up(h, params[p + "w_up"])
+        h = ffn_down(ffn_glu(g, u), params[p + "w_down"])
+        x = residual_add(x, h)
+    x = rms_norm(x, params["final_norm_g"], config.rms_eps)
+    return lm_head(x, params["lm_head"])
+
+
+def loss_fn(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    targets: jax.Array,
+    config: LlamaConfig,
+) -> jax.Array:
+    logits = forward(params, input_ids, config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

@@ -236,6 +236,7 @@ class MRUScheduler(BaseScheduler):
 
 
 from .heft import HEFTScheduler  # noqa: E402  (avoids a circular import)
+from .pipeline import PipelineStageScheduler  # noqa: E402
 
 ALL_SCHEDULERS = {
     cls.name: cls
@@ -246,6 +247,7 @@ ALL_SCHEDULERS = {
         CriticalPathScheduler,
         MRUScheduler,
         HEFTScheduler,
+        PipelineStageScheduler,
     )
 }
 

@@ -16,7 +16,7 @@ from typing import List, Optional, Tuple
 @dataclasses.dataclass
 class RunConfig:
     # workload
-    model: str = "gpt2"            # gpt2 | gpt2-medium | gpt2-tiny | llm | random | pipeline
+    model: str = "gpt2"            # gpt2[-medium|-tiny] | llama[-8b|-tiny] | llm | random | pipeline
     batch: int = 1
     seq_len: int = 512
     microbatches: int = 1
@@ -50,15 +50,43 @@ class RunConfig:
         from ..models.gpt2 import GPT2Config
 
         if self.model.startswith("gpt2"):
-            cfg = {
+            maker = {
                 "gpt2": GPT2Config.small,
                 "gpt2-medium": GPT2Config.medium,
                 "gpt2-tiny": GPT2Config.tiny,
-            }[self.model]()
+            }.get(self.model)
+            if maker is None:
+                raise ValueError(
+                    f"unknown model {self.model!r}; gpt2 variants are "
+                    "gpt2 / gpt2-medium / gpt2-tiny"
+                )
+            cfg = maker()
             if self.num_layers:
                 cfg = dataclasses.replace(cfg, n_layer=self.num_layers)
             seq = min(self.seq_len, cfg.n_positions)
             return build_gpt2_dag(
+                cfg, batch=self.batch, seq_len=seq,
+                microbatches=self.microbatches,
+            )
+        if self.model.startswith("llama"):
+            from ..frontend.llama_dag import build_llama_dag
+            from ..models.llama import LlamaConfig
+
+            maker = {
+                "llama": LlamaConfig.llama3_8b,
+                "llama-8b": LlamaConfig.llama3_8b,
+                "llama-tiny": LlamaConfig.tiny,
+            }.get(self.model)
+            if maker is None:
+                raise ValueError(
+                    f"unknown model {self.model!r}; llama variants are "
+                    "llama / llama-8b / llama-tiny"
+                )
+            cfg = maker()
+            if self.num_layers:
+                cfg = dataclasses.replace(cfg, n_layers=self.num_layers)
+            seq = min(self.seq_len, cfg.max_seq_len)
+            return build_llama_dag(
                 cfg, batch=self.batch, seq_len=seq,
                 microbatches=self.microbatches,
             )
@@ -76,7 +104,8 @@ class RunConfig:
         if self.model not in makers:
             raise ValueError(
                 f"unknown model {self.model!r}; choose gpt2 / gpt2-medium / "
-                "gpt2-tiny / llm / random / pipeline"
+                "gpt2-tiny / llama / llama-8b / llama-tiny / llm / random / "
+                "pipeline"
             )
         return makers[self.model]()
 

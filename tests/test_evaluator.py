@@ -21,8 +21,10 @@ def small_sweep():
 
 
 def test_sweep_produces_all_trials(small_sweep):
-    # 1 workload x 2 node counts x 2 regimes x 2 runs x 6 schedulers
-    assert len(small_sweep.reports) == 1 * 2 * 2 * 2 * 6
+    from distributed_llm_scheduler_tpu.sched.policies import ALL_SCHEDULERS
+
+    # 1 workload x 2 node counts x 2 regimes x 2 runs x every scheduler
+    assert len(small_sweep.reports) == 1 * 2 * 2 * 2 * len(ALL_SCHEDULERS)
 
 
 def test_mru_headline_behavior(small_sweep):
@@ -52,8 +54,10 @@ def test_csv_and_plots_written(small_sweep, tmp_path):
 
 
 def test_summary_fields(small_sweep):
+    from distributed_llm_scheduler_tpu.sched.policies import ALL_SCHEDULERS
+
     s = small_sweep.summarize()
-    assert set(s["mean_metrics"]) == {"critical", "dfs", "greedy", "heft", "mru", "roundrobin"}
+    assert set(s["mean_metrics"]) == set(ALL_SCHEDULERS)
     assert s["best_completion"] in s["mean_metrics"]
     assert "llm_cache_hit_rate" in s
     small_sweep.print_summary()
