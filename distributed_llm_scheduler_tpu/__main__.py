@@ -21,13 +21,15 @@ import sys
 
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--model", default="gpt2",
-                   help="gpt2 | gpt2-medium | gpt2-tiny | llama | llama-8b | "
-                        "llama-tiny | llm | random | pipeline")
+                   help="gpt2[-medium|-tiny] | llama[-8b|-tiny] | "
+                        "mixtral[-8x7b|-tiny] | llm | random | pipeline")
     p.add_argument("--backend", default="sim",
                    help="sim | sim-reference (replay fidelity for schedule/visualize)")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--microbatches", type=int, default=1)
+    p.add_argument("--train-step", action="store_true",
+                   help="schedule one fwd+bwd+optimizer step (gpt2* models)")
     p.add_argument("--num-layers", type=int, default=None)
     p.add_argument("--num-nodes", type=int, default=8)
     p.add_argument("--hbm-gb", type=float, default=14.0)
@@ -107,8 +109,8 @@ def cmd_execute(args) -> int:
     cfg = _config_from(args)
     dag = cfg.build_graph()
     if not hasattr(dag, "graph"):
-        print("execute needs a model DAG (gpt2* or llama*); synthetic graphs "
-              "have no fns", file=sys.stderr)
+        print("execute needs a model DAG (gpt2* / llama* / mixtral*); "
+              "synthetic graphs have no fns", file=sys.stderr)
         return 2
     cluster = cfg.build_cluster_with_devices()
     schedule = get_scheduler(cfg.scheduler).schedule(dag.graph, cluster)

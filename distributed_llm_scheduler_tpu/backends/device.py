@@ -74,10 +74,15 @@ class DeviceReport:
 
 
 def _array_bytes(x: Any) -> int:
-    try:
-        return x.size * x.dtype.itemsize
-    except Exception:
-        return 0
+    """Bytes of an array or an arbitrary pytree of arrays (train-step tasks
+    exchange dicts of grads)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(x):
+        try:
+            total += leaf.size * leaf.dtype.itemsize
+        except Exception:
+            pass
+    return total
 
 
 class DeviceBackend:
@@ -212,7 +217,7 @@ class DeviceBackend:
             if profile:
                 t0 = time.perf_counter()
                 out = fn(pd, *args)
-                out.block_until_ready()
+                jax.block_until_ready(out)  # out may be a pytree (train DAG)
                 t1 = time.perf_counter()
                 timings[tid] = TaskTiming(
                     tid, node_id, t0 - t_start, t1 - t_start

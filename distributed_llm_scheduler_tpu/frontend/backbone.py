@@ -1,0 +1,178 @@
+"""Shared decoder-DAG backbone for the Llama-architecture families.
+
+Llama (:mod:`.llama_dag`) and Mixtral (:mod:`.moe_dag`) differ only in the
+FFN section of each layer (SwiGLU vs router+experts+combine); everything
+else — embedding, RMSNorm, GQA attention, residual joins, final norm,
+LM head, microbatch chains — is the same task structure with the same
+param-naming scheme.  This module owns that shared assembly so FLOP
+formulas and task-granularity conventions stay in one place; each family
+supplies only an ``ffn_section`` callback.
+
+(The GPT-2 frontend keeps its own assembly in :mod:`.gpt2_dag`: LayerNorm
+with biases, learned positions, fused-QKV attention, and weight tying make
+its structure genuinely different, and its task ids mirror the reference's
+extractor, reference ``test_gpt2.py:54-166``.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.graph import Task, TaskGraph
+from .gpt2_dag import ModelDAG, _bytes_of, _GB
+
+# ffn_section(add, mb, layer, ffn_norm_tid, group) -> FFN output task id
+FfnSection = Callable[[Callable[..., None], str, int, str, str], str]
+
+
+def build_decoder_dag(
+    config: Any,
+    module: Any,
+    *,
+    batch: int,
+    seq_len: int,
+    microbatches: int,
+    effective_flops: float,
+    ffn_section: FfnSection,
+    name: str,
+) -> ModelDAG:
+    """Assemble a llama-architecture forward DAG.
+
+    ``config`` must expose vocab_size/max_seq_len/d_model/n_layers/n_heads/
+    n_kv_heads/head_dim/rope_theta/rms_eps; ``module`` the functional ops
+    (embedding, rms_norm, gqa_attention, residual_add, lm_head) plus
+    init_params/param_shapes/forward.
+    """
+    if seq_len > config.max_seq_len:
+        raise ValueError(f"seq_len {seq_len} exceeds max_seq_len {config.max_seq_len}")
+    if batch % microbatches != 0:
+        raise ValueError(f"batch {batch} not divisible by microbatches {microbatches}")
+    B, T, D, V = batch, seq_len, config.d_model, config.vocab_size
+    H, Hkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    Bm = B // microbatches
+    eps = config.rms_eps
+
+    specs = {
+        pname: jax.ShapeDtypeStruct(shape, dtype)
+        for pname, (shape, dtype) in module.param_shapes(config).items()
+    }
+    input_spec = jax.ShapeDtypeStruct((B, T), jnp.int32)
+
+    tasks: List[Task] = []
+    out_specs: Dict[str, Any] = {}
+
+    def add(tid, fn, deps, alias, flops, group):
+        dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
+        pspec = {loc: specs[glob] for loc, glob in alias.items()}
+        out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
+        out_specs[tid] = out
+        globals_ = list(alias.values())
+        tasks.append(
+            Task(
+                tid,
+                memory_required=_bytes_of(out) / _GB,
+                compute_time=max(flops / effective_flops, 1e-7),
+                dependencies=list(deps),
+                params_needed=set(globals_),
+                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
+                fn=fn,
+                arg_tasks=list(deps),
+                param_alias=dict(alias),
+                out_shape=out,
+                flops=flops,
+                group=group,
+            )
+        )
+
+    # ---- shared task fns: fn(params_dict, *dep_outputs) ------------------
+    def make_f_embedding(lo, hi):
+        def f_embedding(p, input_ids):
+            return module.embedding(input_ids[lo:hi], p["tok_emb"])
+
+        return f_embedding
+
+    def f_concat(p, *chunks):
+        return jnp.concatenate(chunks, axis=0)
+
+    def f_norm(p, x):
+        return module.rms_norm(x, p["g"], eps)
+
+    def f_attn(p, x):
+        return module.gqa_attention(
+            x, p["wq"], p["wk"], p["wv"], p["wo"],
+            config.n_heads, config.n_kv_heads, config.rope_theta,
+        )
+
+    def f_residual(p, a, b):
+        return module.residual_add(a, b)
+
+    def f_lm_head(p, x):
+        return module.lm_head(x, p["w"])
+
+    attn_flops = (
+        2.0 * Bm * T * D * (H * hd)            # q projection
+        + 2.0 * 2.0 * Bm * T * D * (Hkv * hd)  # k and v projections
+        + 2.0 * 2.0 * Bm * H * T * T * hd      # scores + probs@v
+        + 2.0 * Bm * T * (H * hd) * D          # output projection
+    )
+
+    # ---- graph assembly --------------------------------------------------
+    mb_outputs: List[str] = []
+    for m in range(microbatches):
+        mb = f"mb{m}_" if microbatches > 1 else ""
+        emb = f"{mb}embedding"
+        add(emb, make_f_embedding(m * Bm, (m + 1) * Bm), [],
+            {"tok_emb": "tok_emb"}, 2.0 * Bm * T * D, "embed")
+
+        prev = emb
+        for i in range(config.n_layers):
+            pre, grp = f"l{i}_", f"layer_{i}"
+            an = f"{mb}layer_{i}_attn_norm"
+            add(an, f_norm, [prev], {"g": pre + "attn_norm_g"},
+                4.0 * Bm * T * D, grp)
+
+            attn = f"{mb}layer_{i}_attention"
+            add(attn, f_attn, [an],
+                {"wq": pre + "wq", "wk": pre + "wk",
+                 "wv": pre + "wv", "wo": pre + "wo"}, attn_flops, grp)
+
+            ares = f"{mb}layer_{i}_attn_residual"
+            add(ares, f_residual, [prev, attn], {}, 1.0 * Bm * T * D, grp)
+
+            fnorm = f"{mb}layer_{i}_ffn_norm"
+            add(fnorm, f_norm, [ares], {"g": pre + "ffn_norm_g"},
+                4.0 * Bm * T * D, grp)
+
+            ffn_out = ffn_section(add, mb, i, fnorm, grp)
+
+            lout = f"{mb}layer_{i}_output"
+            add(lout, f_residual, [ares, ffn_out], {}, 1.0 * Bm * T * D, grp)
+            prev = lout
+
+        fnorm_id = f"{mb}final_norm"
+        add(fnorm_id, f_norm, [prev], {"g": "final_norm_g"},
+            4.0 * Bm * T * D, "head")
+        head = f"{mb}lm_head"
+        add(head, f_lm_head, [fnorm_id], {"w": "lm_head"},
+            2.0 * Bm * T * D * V, "head")
+        mb_outputs.append(head)
+
+    if microbatches > 1:
+        add("output_concat", f_concat, mb_outputs, {}, 1.0 * B * T * V, "head")
+
+    graph = TaskGraph(tasks, name=name).freeze()
+
+    def reference_forward(p, ids):
+        return module.forward(p, ids, config)
+
+    return ModelDAG(
+        graph=graph,
+        config=config,
+        input_spec=input_spec,
+        param_specs=specs,
+        reference_forward=reference_forward,
+        init_fn=lambda key: module.init_params(config, key),
+    )

@@ -1,34 +1,29 @@
 """Llama-3 layer-wise forward DAG builder (BASELINE.json config #3).
 
-Same design as :mod:`.gpt2_dag` but for the Llama architecture: per layer
-the tasks are {attn_norm, attention (GQA+RoPE), attn_residual, ffn_norm,
-ffn_gate, ffn_up, ffn_glu, ffn_down, layer_output} — 9 tasks/layer — plus
-embedding, final_norm, and lm_head: ``9 * n_layers + 3`` tasks (291 for
-Llama-3 8B).  The reference has no Llama frontend (its extractor is
-GPT-2-only, reference ``test_gpt2.py:45-168``); the task-granularity
-conventions (attention incl. its projections as ONE task, residual adds as
-join tasks) mirror the reference's GPT-2 structure so every scheduling
-policy treats both families uniformly.
+Per layer the tasks are {attn_norm, attention (GQA+RoPE), attn_residual,
+ffn_norm, ffn_gate, ffn_up, ffn_glu, ffn_down, layer_output} — 9
+tasks/layer — plus embedding, final_norm, and lm_head: ``9 * n_layers + 3``
+tasks (291 for Llama-3 8B).  The reference has no Llama frontend (its
+extractor is GPT-2-only, reference ``test_gpt2.py:45-168``); the
+task-granularity conventions mirror the reference's GPT-2 structure so
+every scheduling policy treats both families uniformly.
 
-Every task carries a jittable fn, real param byte sizes, eval_shape'd
-activation sizes, and analytic FLOPs — see ``gpt2_dag.py`` for rationale.
-``microbatches > 1`` produces the pipeline-shaped workload used by the
-pipeline-stage scheduler (``sched/pipeline.py``) for the "Llama-3 8B
-pipeline-stage scheduling across v5e-16" config.
+The backbone assembly (embedding/attention/norms/residuals/head) lives in
+:mod:`.backbone`, shared with the Mixtral frontend; only the SwiGLU FFN
+section is defined here.  ``microbatches > 1`` produces the
+pipeline-shaped workload used by the pipeline-stage scheduler
+(``sched/pipeline.py``) for the "Llama-3 8B pipeline-stage scheduling
+across v5e-16" config.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Dict, List, Optional
+from typing import Optional
 
-import jax
-import jax.numpy as jnp
-
-from ..core.graph import Task, TaskGraph
 from ..models import llama
 from ..models.llama import LlamaConfig
-from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, _bytes_of, _GB
+from .backbone import build_decoder_dag
+from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG
 
 
 def build_llama_dag(
@@ -38,75 +33,11 @@ def build_llama_dag(
     microbatches: int = 1,
     effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
 ) -> ModelDAG:
-    """Build the per-op forward DAG for a Llama config.
-
-    With ``microbatches > 1`` the batch splits into independent chains
-    sharing layer weights, joined by a final concat — the DAG shape of
-    pipeline parallelism (see ``gpt2_dag.build_gpt2_dag``).
-    """
+    """Build the per-op forward DAG for a Llama config."""
     config = config or LlamaConfig.llama3_8b()
-    if seq_len > config.max_seq_len:
-        raise ValueError(f"seq_len {seq_len} exceeds max_seq_len {config.max_seq_len}")
-    if batch % microbatches != 0:
-        raise ValueError(f"batch {batch} not divisible by microbatches {microbatches}")
-    B, T, D, V = batch, seq_len, config.d_model, config.vocab_size
-    H, Hkv, hd, F = config.n_heads, config.n_kv_heads, config.head_dim, config.ffn_hidden
-    Bm = B // microbatches
-    eps = config.rms_eps
-
-    specs = {
-        name: jax.ShapeDtypeStruct(shape, dtype)
-        for name, (shape, dtype) in llama.param_shapes(config).items()
-    }
-    input_spec = jax.ShapeDtypeStruct((B, T), jnp.int32)
-
-    tasks: List[Task] = []
-    out_specs: Dict[str, Any] = {}
-
-    def add(tid, fn, deps, alias, flops, group):
-        dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
-        pspec = {loc: specs[glob] for loc, glob in alias.items()}
-        out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
-        out_specs[tid] = out
-        globals_ = list(alias.values())
-        tasks.append(
-            Task(
-                tid,
-                memory_required=_bytes_of(out) / _GB,
-                compute_time=max(flops / effective_flops, 1e-7),
-                dependencies=list(deps),
-                params_needed=set(globals_),
-                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
-                fn=fn,
-                arg_tasks=list(deps),
-                param_alias=dict(alias),
-                out_shape=out,
-                flops=flops,
-                group=group,
-            )
-        )
-
-    # ---- shared task fns: fn(params_dict, *dep_outputs) ------------------
-    def make_f_embedding(lo, hi):
-        def f_embedding(p, input_ids):
-            return llama.embedding(input_ids[lo:hi], p["tok_emb"])
-
-        return f_embedding
-
-    def f_concat(p, *chunks):
-        return jnp.concatenate(chunks, axis=0)
-
-    def f_norm(p, x):
-        return llama.rms_norm(x, p["g"], eps)
-
-    def f_attn(p, x):
-        return llama.gqa_attention(
-            x, p["wq"], p["wk"], p["wv"], p["wo"],
-            config.n_heads, config.n_kv_heads, config.rope_theta,
-        )
-
-    def f_residual(p, a, b):
-        return llama.residual_add(a, b)
+    D, F = config.d_model, config.ffn_hidden
+    Bm = (batch // microbatches) if microbatches else batch
+    T = seq_len
 
     def f_gate(p, x):
         return llama.ffn_gate(x, p["w"])
@@ -120,78 +51,28 @@ def build_llama_dag(
     def f_down(p, x):
         return llama.ffn_down(x, p["w"])
 
-    def f_lm_head(p, x):
-        return llama.lm_head(x, p["w"])
+    def ffn_section(add, mb, i, fnorm, grp):
+        """SwiGLU as four tasks: gate and up matmuls in parallel, the GLU
+        join, then the down projection."""
+        pre = f"l{i}_"
+        gate = f"{mb}layer_{i}_ffn_gate"
+        add(gate, f_gate, [fnorm], {"w": pre + "w_gate"},
+            2.0 * Bm * T * D * F, grp)
+        up = f"{mb}layer_{i}_ffn_up"
+        add(up, f_up, [fnorm], {"w": pre + "w_up"},
+            2.0 * Bm * T * D * F, grp)
+        glu = f"{mb}layer_{i}_ffn_glu"
+        add(glu, f_glu, [gate, up], {}, 6.0 * Bm * T * F, grp)
+        down = f"{mb}layer_{i}_ffn_down"
+        add(down, f_down, [glu], {"w": pre + "w_down"},
+            2.0 * Bm * T * F * D, grp)
+        return down
 
-    # ---- graph assembly --------------------------------------------------
-    mb_outputs: List[str] = []
-    for m in range(microbatches):
-        mb = f"mb{m}_" if microbatches > 1 else ""
-        emb = f"{mb}embedding"
-        add(emb, make_f_embedding(m * Bm, (m + 1) * Bm), [],
-            {"tok_emb": "tok_emb"}, 2.0 * Bm * T * D, "embed")
-
-        prev = emb
-        for i in range(config.n_layers):
-            pre, grp = f"l{i}_", f"layer_{i}"
-            an = f"{mb}layer_{i}_attn_norm"
-            add(an, f_norm, [prev], {"g": pre + "attn_norm_g"},
-                4.0 * Bm * T * D, grp)
-
-            attn = f"{mb}layer_{i}_attention"
-            attn_flops = (
-                2.0 * Bm * T * D * (H * hd)        # q projection
-                + 2.0 * 2.0 * Bm * T * D * (Hkv * hd)  # k and v projections
-                + 2.0 * 2.0 * Bm * H * T * T * hd  # scores + probs@v
-                + 2.0 * Bm * T * (H * hd) * D      # output projection
-            )
-            add(attn, f_attn, [an],
-                {"wq": pre + "wq", "wk": pre + "wk",
-                 "wv": pre + "wv", "wo": pre + "wo"}, attn_flops, grp)
-
-            ares = f"{mb}layer_{i}_attn_residual"
-            add(ares, f_residual, [prev, attn], {}, 1.0 * Bm * T * D, grp)
-
-            fn_ = f"{mb}layer_{i}_ffn_norm"
-            add(fn_, f_norm, [ares], {"g": pre + "ffn_norm_g"},
-                4.0 * Bm * T * D, grp)
-
-            gate = f"{mb}layer_{i}_ffn_gate"
-            add(gate, f_gate, [fn_], {"w": pre + "w_gate"},
-                2.0 * Bm * T * D * F, grp)
-            up = f"{mb}layer_{i}_ffn_up"
-            add(up, f_up, [fn_], {"w": pre + "w_up"},
-                2.0 * Bm * T * D * F, grp)
-            glu = f"{mb}layer_{i}_ffn_glu"
-            add(glu, f_glu, [gate, up], {}, 6.0 * Bm * T * F, grp)
-            down = f"{mb}layer_{i}_ffn_down"
-            add(down, f_down, [glu], {"w": pre + "w_down"},
-                2.0 * Bm * T * F * D, grp)
-
-            lout = f"{mb}layer_{i}_output"
-            add(lout, f_residual, [ares, down], {}, 1.0 * Bm * T * D, grp)
-            prev = lout
-
-        fn_norm_id = f"{mb}final_norm"
-        add(fn_norm_id, f_norm, [prev], {"g": "final_norm_g"},
-            4.0 * Bm * T * D, "head")
-        head = f"{mb}lm_head"
-        add(head, f_lm_head, [fn_norm_id], {"w": "lm_head"},
-            2.0 * Bm * T * D * V, "head")
-        mb_outputs.append(head)
-
-    if microbatches > 1:
-        add("output_concat", f_concat, mb_outputs, {}, 1.0 * B * T * V, "head")
-
-    name = f"llama_{config.n_layers}l_d{D}_b{B}_t{T}" + (
+    name = f"llama_{config.n_layers}l_d{D}_b{batch}_t{T}" + (
         f"_mb{microbatches}" if microbatches > 1 else ""
     )
-    graph = TaskGraph(tasks, name=name).freeze()
-    return ModelDAG(
-        graph=graph,
-        config=config,
-        input_spec=input_spec,
-        param_specs=specs,
-        reference_forward=partial(llama.forward, config=config),
-        init_fn=lambda key: llama.init_params(config, key),
+    return build_decoder_dag(
+        config, llama,
+        batch=batch, seq_len=seq_len, microbatches=microbatches,
+        effective_flops=effective_flops, ffn_section=ffn_section, name=name,
     )

@@ -1,0 +1,86 @@
+"""Mixtral MoE forward DAG builder: expert nodes as tasks
+(BASELINE.json config #4).
+
+Per layer the tasks are {attn_norm, attention, attn_residual, ffn_norm,
+router, expert_0..E-1, moe_combine, layer_output} — ``7 + E`` tasks/layer —
+plus embedding, final_norm, lm_head: ``(7 + n_experts) * n_layers + 3``
+(483 for Mixtral-8x7B).  Each expert task owns that expert's three FFN
+matrices (~176 MB each for 8x7B), so placement of experts under per-core
+HBM limits is exactly the param-cache-locality problem the reference's MRU
+policy targets (SURVEY.md §7 stage 8: "expert-placement = param-cache
+locality, MRU's sweet spot").  The reference itself has no MoE.
+
+The backbone assembly lives in :mod:`.backbone`, shared with the Llama
+frontend; only the router/experts/combine section is defined here.
+Experts compute densely (see :mod:`..models.mixtral` for why XLA wants
+that); expert-task FLOPs are recorded as the *useful* top_k/E fraction so
+cost-model comparisons against measured dense timings expose the overhead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..models import mixtral
+from ..models.mixtral import MixtralConfig
+from .backbone import build_decoder_dag
+from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG
+
+
+def build_moe_dag(
+    config: Optional[MixtralConfig] = None,
+    batch: int = 1,
+    seq_len: int = 512,
+    microbatches: int = 1,
+    effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
+) -> ModelDAG:
+    """Build the per-op forward DAG for a Mixtral config, one task per
+    expert."""
+    config = config or MixtralConfig.mixtral_8x7b()
+    D, F = config.d_model, config.ffn_hidden
+    E, K = config.n_experts, config.top_k
+    Bm = (batch // microbatches) if microbatches else batch
+    T = seq_len
+
+    def f_router(p, x):
+        return mixtral.router_weights(x, p["w"], config.top_k)
+
+    def f_expert(p, x):
+        return mixtral.expert_ffn(x, p["w_gate"], p["w_up"], p["w_down"])
+
+    def f_combine(p, weights, *outs):
+        return mixtral.moe_combine(weights, *outs)
+
+    def ffn_section(add, mb, i, fnorm, grp):
+        """Router + E dense expert tasks fanning out from the FFN norm,
+        joined by the gate-weighted combine."""
+        pre = f"l{i}_"
+        router = f"{mb}layer_{i}_router"
+        add(router, f_router, [fnorm], {"w": pre + "router"},
+            2.0 * Bm * T * D * E, grp)
+
+        expert_ids = []
+        # useful-work fraction: each token activates top_k of E experts
+        expert_flops = (6.0 * Bm * T * D * F) * (K / E)
+        for e in range(E):
+            ex = f"{mb}layer_{i}_expert_{e}"
+            add(ex, f_expert, [fnorm],
+                {"w_gate": f"{pre}e{e}_w_gate",
+                 "w_up": f"{pre}e{e}_w_up",
+                 "w_down": f"{pre}e{e}_w_down"},
+                expert_flops, grp)
+            expert_ids.append(ex)
+
+        comb = f"{mb}layer_{i}_moe_combine"
+        add(comb, f_combine, [router] + expert_ids, {},
+            2.0 * Bm * T * D * E, grp)
+        return comb
+
+    name = f"mixtral_{config.n_layers}l_d{D}_e{E}_b{batch}_t{T}" + (
+        f"_mb{microbatches}" if microbatches > 1 else ""
+    )
+    return build_decoder_dag(
+        config, mixtral,
+        batch=batch, seq_len=seq_len, microbatches=microbatches,
+        effective_flops=effective_flops, ffn_section=ffn_section, name=name,
+    )

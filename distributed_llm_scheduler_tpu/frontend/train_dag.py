@@ -1,0 +1,281 @@
+"""GPT-2 training-step DAG: forward + backward + optimizer as tasks
+(BASELINE.json config #5).
+
+The reference schedules forward passes only; its paper lists training as
+future work.  Here one SGD training step becomes a task DAG whose backward
+edges invert the forward chain — the activation-memory-stress workload
+SURVEY.md §7 stage 8 calls for:
+
+* ``batch`` — identity root carrying ``{"ids", "targets"}`` to consumers;
+* ``embedding_fwd``, ``layer_{i}_fwd`` — layer-granular forward; each
+  output (the residual stream entering layer i+1) must stay live until
+  ``layer_{i}_bwd`` consumes it at the far end of the schedule;
+* ``head_bwd`` — final LN + tied-weight logits + cross-entropy loss and
+  its VJP in one task (returns loss, dL/dx_L, head param grads);
+* ``layer_{i}_bwd`` — **rematerializing** VJP: recomputes layer i's
+  forward from its saved input inside ``jax.vjp`` (the ``jax.checkpoint``
+  trade of FLOPs for memory, TPU-idiomatic) — so tasks exchange only
+  plain arrays/pytrees, and each layer's params are needed a *second*
+  time, far from the first — the eviction-stress pattern;
+* ``opt_layer_{i}`` / ``opt_head`` / ``opt_embed`` — SGD updates; the
+  tied embedding table receives summed grads from ``embedding_bwd`` and
+  ``head_bwd`` (weight tying, reference ``test_gpt2.py:160-166``);
+* ``step_out`` — gathers the new params + loss (the training-step state
+  handoff).
+
+Total: ``3 * n_layer + 7`` tasks.  Backward FLOPs are seeded at 2x forward
+(standard ratio); calibration replaces them with measurements.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.graph import Task, TaskGraph
+from ..models import gpt2
+from ..models.gpt2 import GPT2Config
+from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, _bytes_of, _GB
+
+
+def _bytes_tree(out: Any) -> int:
+    return sum(_bytes_of(l) for l in jax.tree_util.tree_leaves(out))
+
+
+class TrainDAG(ModelDAG):
+    """ModelDAG whose input is ``{"ids", "targets"}`` and whose
+    ``reference_forward`` is the fused one-step oracle returning
+    ``{"loss", "params"}``."""
+
+    def make_inputs(self, key: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
+        key = key if key is not None else jax.random.PRNGKey(1)
+        k1, k2 = jax.random.split(key)
+        shape = self.input_spec["ids"].shape
+        V = self.config.vocab_size
+        return {
+            "ids": jax.random.randint(k1, shape, 0, V, dtype=jnp.int32),
+            "targets": jax.random.randint(k2, shape, 0, V, dtype=jnp.int32),
+        }
+
+
+def _layer_params(i: int) -> List[str]:
+    p = f"h{i}_"
+    return [p + s for s in (
+        "ln1_g", "ln1_b", "attn_qkv_w", "attn_qkv_b", "attn_proj_w",
+        "attn_proj_b", "ln2_g", "ln2_b", "mlp_fc_w", "mlp_fc_b",
+        "mlp_proj_w", "mlp_proj_b",
+    )]
+
+
+def build_gpt2_train_dag(
+    config: Optional[GPT2Config] = None,
+    batch: int = 1,
+    seq_len: int = 128,
+    lr: float = 1e-3,
+    effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
+) -> TrainDAG:
+    """One SGD step over our GPT-2 as a schedulable task DAG."""
+    config = config or GPT2Config.small()
+    if seq_len > config.n_positions:
+        raise ValueError(f"seq_len {seq_len} exceeds n_positions {config.n_positions}")
+    B, T, D, V = batch, seq_len, config.n_embd, config.vocab_size
+    eps, n_head = config.ln_eps, config.n_head
+
+    specs = {
+        name: jax.ShapeDtypeStruct(shape, dtype)
+        for name, (shape, dtype) in gpt2.param_shapes(config).items()
+    }
+    input_spec = {
+        "ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+        "targets": jax.ShapeDtypeStruct((B, T), jnp.int32),
+    }
+
+    tasks: List[Task] = []
+    out_specs: Dict[str, Any] = {}
+
+    def add(tid, fn, deps, alias, flops, group):
+        dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
+        pspec = {loc: specs[glob] for loc, glob in alias.items()}
+        out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
+        out_specs[tid] = out
+        globals_ = list(alias.values())
+        tasks.append(
+            Task(
+                tid,
+                memory_required=_bytes_tree(out) / _GB,
+                compute_time=max(flops / effective_flops, 1e-7),
+                dependencies=list(deps),
+                params_needed=set(globals_),
+                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
+                fn=fn,
+                arg_tasks=list(deps),
+                param_alias=dict(alias),
+                out_shape=out,
+                flops=flops,
+                group=group,
+            )
+        )
+
+    # ---- model pieces ----------------------------------------------------
+    def layer_fwd(p: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        """One transformer block with LOCAL param names (alias-mapped)."""
+        ln1 = gpt2.layer_norm(x, p["ln1_g"], p["ln1_b"], eps)
+        attn = gpt2.causal_attention(
+            ln1, p["attn_qkv_w"], p["attn_qkv_b"], p["attn_proj_w"],
+            p["attn_proj_b"], n_head,
+        )
+        x = x + attn
+        ln2 = gpt2.layer_norm(x, p["ln2_g"], p["ln2_b"], eps)
+        h = gpt2.ffn_expand(ln2, p["mlp_fc_w"], p["mlp_fc_b"])
+        h = gpt2.ffn_activation(h)
+        h = gpt2.ffn_contract(h, p["mlp_proj_w"], p["mlp_proj_b"])
+        return x + h
+
+    def head_loss(p: Dict[str, jax.Array], x: jax.Array,
+                  targets: jax.Array) -> jax.Array:
+        h = gpt2.layer_norm(x, p["ln_f_g"], p["ln_f_b"], eps)
+        logits = gpt2.output_projection(h, p["wte"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    # ---- task fns --------------------------------------------------------
+    def f_batch(p, inputs):
+        return inputs
+
+    def f_emb_fwd(p, inputs):
+        return gpt2.embedding(inputs["ids"], p["wte"], p["wpe"])
+
+    def f_layer_fwd(p, x):
+        return layer_fwd(p, x)
+
+    def f_head_bwd(p, x, inputs):
+        """Loss + VJP of (final LN -> tied logits -> cross-entropy)."""
+        loss, vjp = jax.vjp(lambda pp, xx: head_loss(pp, xx, inputs["targets"]), p, x)
+        grads_p, grad_x = vjp(jnp.ones((), loss.dtype))
+        return {"loss": loss, "grad_x": grad_x, "grads": grads_p}
+
+    def f_layer_bwd(p, x_in, upstream):
+        """Rematerializing VJP of one block: recompute fwd from the saved
+        input, pull the upstream cotangent back through it."""
+        _, vjp = jax.vjp(layer_fwd, p, x_in)
+        grads_p, grad_x = vjp(upstream["grad_x"])
+        return {"grad_x": grad_x, "grads": grads_p}
+
+    def f_emb_bwd(p, inputs, upstream):
+        _, vjp = jax.vjp(
+            lambda pp: gpt2.embedding(inputs["ids"], pp["wte"], pp["wpe"]), p
+        )
+        (grads_p,) = vjp(upstream["grad_x"])
+        return {"grads": grads_p}
+
+    def make_f_opt(prefix: str) -> Callable[..., Dict[str, jax.Array]]:
+        """SGD update emitting GLOBAL param names (`h{i}_...`) so step_out
+        can merge per-layer outputs without collisions."""
+
+        def f_opt(p, bwd_out):
+            return {
+                prefix + k: p[k] - lr * bwd_out["grads"][k].astype(p[k].dtype)
+                for k in p
+            }
+
+        return f_opt
+
+    def f_opt_embed(p, emb_bwd_out, head_bwd_out):
+        """Tied wte: sum the embedding-lookup and logits-projection grads."""
+        g_wte = (emb_bwd_out["grads"]["wte"] + head_bwd_out["grads"]["wte"])
+        return {
+            "wte": p["wte"] - lr * g_wte.astype(p["wte"].dtype),
+            "wpe": p["wpe"] - lr * emb_bwd_out["grads"]["wpe"].astype(p["wpe"].dtype),
+        }
+
+    def f_opt_head(p, head_bwd_out):
+        g = head_bwd_out["grads"]
+        return {
+            "ln_f_g": p["ln_f_g"] - lr * g["ln_f_g"].astype(p["ln_f_g"].dtype),
+            "ln_f_b": p["ln_f_b"] - lr * g["ln_f_b"].astype(p["ln_f_b"].dtype),
+        }
+
+    def f_step_out(p, head_bwd_out, *opt_outs):
+        merged: Dict[str, jax.Array] = {}
+        for o in opt_outs:
+            merged.update(o)
+        return {"loss": head_bwd_out["loss"], "params": merged}
+
+    # ---- graph assembly --------------------------------------------------
+    L = config.n_layer
+    layer_flops = (
+        2.0 * B * T * D * 3 * D + 4.0 * B * n_head * T * T * (D // n_head)
+        + 2.0 * B * T * D * D + 16.0 * B * T * D * D + 12.0 * B * T * D
+    )
+    head_flops = 2.0 * B * T * D * V
+    emb_flops = 2.0 * B * T * D
+
+    add("batch", f_batch, [], {}, 1.0 * B * T, "io")
+    add("embedding_fwd", f_emb_fwd, ["batch"],
+        {"wte": "wte", "wpe": "wpe"}, emb_flops, "embed")
+
+    prev = "embedding_fwd"
+    for i in range(L):
+        alias = {s.split("_", 1)[1]: s for s in _layer_params(i)}
+        add(f"layer_{i}_fwd", f_layer_fwd, [prev], alias,
+            layer_flops, f"layer_{i}")
+        prev = f"layer_{i}_fwd"
+
+    # head: loss + its backward in one task (weight-tied wte grads included)
+    add("head_bwd", f_head_bwd, [prev, "batch"],
+        {"ln_f_g": "ln_f_g", "ln_f_b": "ln_f_b", "wte": "wte"},
+        3.0 * head_flops, "head")
+
+    upstream = "head_bwd"
+    for i in reversed(range(L)):
+        x_in = "embedding_fwd" if i == 0 else f"layer_{i - 1}_fwd"
+        alias = {s.split("_", 1)[1]: s for s in _layer_params(i)}
+        add(f"layer_{i}_bwd", f_layer_bwd, [x_in, upstream], alias,
+            2.0 * layer_flops, f"layer_{i}")
+        upstream = f"layer_{i}_bwd"
+
+    add("embedding_bwd", f_emb_bwd, ["batch", upstream],
+        {"wte": "wte", "wpe": "wpe"}, 2.0 * emb_flops, "embed")
+
+    opt_ids: List[str] = []
+    for i in range(L):
+        alias = {s.split("_", 1)[1]: s for s in _layer_params(i)}
+        tid = f"opt_layer_{i}"
+        add(tid, make_f_opt(f"h{i}_"), [f"layer_{i}_bwd"], alias,
+            2.0 * sum(
+                math.prod(specs[g].shape) for g in _layer_params(i)
+            ), f"layer_{i}")
+        opt_ids.append(tid)
+    add("opt_embed", f_opt_embed, ["embedding_bwd", "head_bwd"],
+        {"wte": "wte", "wpe": "wpe"}, 2.0 * (V + T) * D, "embed")
+    opt_ids.append("opt_embed")
+    add("opt_head", f_opt_head, ["head_bwd"],
+        {"ln_f_g": "ln_f_g", "ln_f_b": "ln_f_b"}, 4.0 * D, "head")
+    opt_ids.append("opt_head")
+
+    add("step_out", f_step_out, ["head_bwd"] + opt_ids, {},
+        1.0 * B * T, "io")
+
+    # ---- fused one-step oracle ------------------------------------------
+    def reference_step(params: Dict[str, jax.Array],
+                       inputs: Dict[str, jax.Array]) -> Dict[str, Any]:
+        loss, grads = jax.value_and_grad(gpt2.loss_fn)(
+            params, inputs["ids"], inputs["targets"], config
+        )
+        new = {k: params[k] - lr * grads[k].astype(params[k].dtype) for k in params}
+        return {"loss": loss, "params": new}
+
+    name = f"gpt2_train_{L}l_d{D}_b{B}_t{T}"
+    graph = TaskGraph(tasks, name=name).freeze()
+    return TrainDAG(
+        graph=graph,
+        config=config,
+        input_spec=input_spec,
+        param_specs=specs,
+        reference_forward=reference_step,
+        init_fn=lambda key: gpt2.init_params(config, key),
+    )

@@ -1,0 +1,214 @@
+"""Mixtral-style sparse MoE in pure JAX: third model family
+(BASELINE.json config #4: "Mixtral-8x7B MoE DAG, expert nodes as tasks").
+
+Architecture = Llama backbone (RMSNorm, RoPE, GQA — reused from
+:mod:`.llama`) with the SwiGLU FFN replaced by a router + N experts with
+top-k gating.  The reference never models MoE (its extractor is GPT-2-only,
+reference ``test_gpt2.py:45-168``); this family exists because expert
+placement is exactly the param-cache-locality problem the reference's MRU
+scheduler targets: each expert is a large, independently placeable set of
+weights used by a data-dependent subset of tokens.
+
+TPU/XLA note on routing: task DAGs need static shapes, so experts compute
+**densely** — every expert processes every token and its output is scaled
+by the (possibly zero) top-k gate weight.  That is the standard
+static-shape MoE formulation for XLA (no gather/scatter of variable token
+counts); the fused oracle uses the same math, so DAG execution matches it
+exactly.  The FLOP *estimates* on expert tasks are scaled by top_k/n_experts
+(the useful work) while the dense cost appears in measured calibration —
+the gap is visible, not hidden.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import llama as _llama
+
+# the Llama backbone ops are the same module-level functions
+rms_norm = _llama.rms_norm
+embedding = _llama.embedding
+gqa_attention = _llama.gqa_attention
+residual_add = _llama.residual_add
+lm_head = _llama.lm_head
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32_000
+    max_seq_len: int = 8192
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14_336
+    n_experts: int = 8
+    top_k: int = 2
+    rope_theta: float = 1_000_000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
+        """Mixtral-8x7B (46.7B total / ~12.9B active params)."""
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "MixtralConfig":
+        """Test-sized: 2 layers, 4 experts, top-2 — CPU-fast, same topology."""
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("d_model", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("n_kv_heads", 2)
+        kw.setdefault("ffn_hidden", 128)
+        kw.setdefault("n_experts", 4)
+        kw.setdefault("top_k", 2)
+        kw.setdefault("rope_theta", 10_000.0)
+        return cls(**kw)
+
+
+# -- parameter init ---------------------------------------------------------
+
+def init_params(config: MixtralConfig, key: jax.Array) -> Dict[str, jax.Array]:
+    """Flat naming scheme shared with the DAG frontend: the Llama names plus
+    ``l{i}_router`` and per-expert ``l{i}_e{e}_w_gate/w_up/w_down``."""
+    std = 0.02
+    d, dtype = config.d_model, config.dtype
+    hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    f, E = config.ffn_hidden, config.n_experts
+    params: Dict[str, jax.Array] = {}
+
+    def normal(key, shape, scale=std):
+        return (scale * jax.random.normal(key, shape)).astype(dtype)
+
+    keys = iter(jax.random.split(key, 2 + config.n_layers * (5 + 3 * E)))
+    params["tok_emb"] = normal(next(keys), (config.vocab_size, d))
+    out_scale = std / math.sqrt(2 * config.n_layers)
+    for i in range(config.n_layers):
+        p = f"l{i}_"
+        params[p + "attn_norm_g"] = jnp.ones((d,), dtype)
+        params[p + "wq"] = normal(next(keys), (d, nh * hd))
+        params[p + "wk"] = normal(next(keys), (d, nkv * hd))
+        params[p + "wv"] = normal(next(keys), (d, nkv * hd))
+        params[p + "wo"] = normal(next(keys), (nh * hd, d), out_scale)
+        params[p + "ffn_norm_g"] = jnp.ones((d,), dtype)
+        params[p + "router"] = normal(next(keys), (d, E))
+        for e in range(E):
+            q = f"{p}e{e}_"
+            params[q + "w_gate"] = normal(next(keys), (d, f))
+            params[q + "w_up"] = normal(next(keys), (d, f))
+            params[q + "w_down"] = normal(next(keys), (f, d), out_scale)
+    params["final_norm_g"] = jnp.ones((d,), dtype)
+    params["lm_head"] = normal(next(keys), (d, config.vocab_size))
+    return params
+
+
+def param_shapes(config: MixtralConfig) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+    shaped = jax.eval_shape(
+        lambda k: init_params(config, k), jax.ShapeDtypeStruct((2,), jnp.uint32)
+    )
+    return {k: (v.shape, v.dtype) for k, v in shaped.items()}
+
+
+def num_params(config: MixtralConfig) -> int:
+    return sum(math.prod(shape) for shape, _ in param_shapes(config).values())
+
+
+def num_active_params(config: MixtralConfig) -> int:
+    """Params touched per token: everything except the (E - top_k)
+    non-selected experts per layer."""
+    per_expert = 3 * config.d_model * config.ffn_hidden
+    inactive = (config.n_experts - config.top_k) * per_expert * config.n_layers
+    return num_params(config) - inactive
+
+
+# -- MoE ops (DAG task granularity) -----------------------------------------
+
+def router_weights(x: jax.Array, w_router: jax.Array, top_k: int) -> jax.Array:
+    """Top-k gate weights, dense layout: (B, T, E) with zeros off the top-k.
+
+    Softmax is taken over the selected logits only (Mixtral semantics:
+    renormalized top-k), in float32.  Static shapes: lax.top_k + one-hot
+    scatter-free reconstruction.
+    """
+    logits = (x @ w_router).astype(jnp.float32)  # (B, T, E)
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)  # (B, T, k)
+    top_w = jax.nn.softmax(top_vals, axis=-1)  # (B, T, k)
+    E = logits.shape[-1]
+    onehot = jax.nn.one_hot(top_idx, E, dtype=top_w.dtype)  # (B, T, k, E)
+    dense = jnp.einsum("btk,btke->bte", top_w, onehot)
+    return dense.astype(x.dtype)
+
+
+def expert_ffn(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+               w_down: jax.Array) -> jax.Array:
+    """One expert's SwiGLU over ALL tokens (dense static-shape MoE)."""
+    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+
+
+def moe_combine(weights: jax.Array, *expert_outs: jax.Array) -> jax.Array:
+    """Sum of expert outputs scaled by their dense gate column."""
+    out = jnp.zeros_like(expert_outs[0])
+    for e, eo in enumerate(expert_outs):
+        out = out + weights[..., e : e + 1] * eo
+    return out
+
+
+def moe_block(params: Dict[str, jax.Array], x: jax.Array, layer: int,
+              config: MixtralConfig) -> jax.Array:
+    """Router + dense experts + combine, as the fused oracle composes it."""
+    p = f"l{layer}_"
+    w = router_weights(x, params[p + "router"], config.top_k)
+    outs = [
+        expert_ffn(
+            x,
+            params[f"{p}e{e}_w_gate"],
+            params[f"{p}e{e}_w_up"],
+            params[f"{p}e{e}_w_down"],
+        )
+        for e in range(config.n_experts)
+    ]
+    return moe_combine(w, *outs)
+
+
+# -- whole-model forward (fused baseline + correctness oracle) --------------
+
+def forward(
+    params: Dict[str, jax.Array], input_ids: jax.Array, config: MixtralConfig
+) -> jax.Array:
+    x = embedding(input_ids, params["tok_emb"])
+    for i in range(config.n_layers):
+        p = f"l{i}_"
+        h = rms_norm(x, params[p + "attn_norm_g"], config.rms_eps)
+        h = gqa_attention(
+            h, params[p + "wq"], params[p + "wk"], params[p + "wv"],
+            params[p + "wo"], config.n_heads, config.n_kv_heads, config.rope_theta,
+        )
+        x = residual_add(x, h)
+        h = rms_norm(x, params[p + "ffn_norm_g"], config.rms_eps)
+        x = residual_add(x, moe_block(params, h, i, config))
+    x = rms_norm(x, params["final_norm_g"], config.rms_eps)
+    return lm_head(x, params["lm_head"])
+
+
+def loss_fn(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    targets: jax.Array,
+    config: MixtralConfig,
+) -> jax.Array:
+    logits = forward(params, input_ids, config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

@@ -16,11 +16,12 @@ from typing import List, Optional, Tuple
 @dataclasses.dataclass
 class RunConfig:
     # workload
-    model: str = "gpt2"            # gpt2[-medium|-tiny] | llama[-8b|-tiny] | llm | random | pipeline
+    model: str = "gpt2"            # gpt2[-medium|-tiny] | llama[-8b|-tiny] | mixtral[-8x7b|-tiny] | llm | random | pipeline
     batch: int = 1
     seq_len: int = 512
     microbatches: int = 1
     num_layers: Optional[int] = None  # synthetic workloads / overrides
+    train_step: bool = False       # schedule one fwd+bwd+opt step (gpt2*)
 
     # cluster
     num_nodes: int = 8
@@ -44,49 +45,79 @@ class RunConfig:
     out_dir: str = "evaluation_results"
     seed: int = 0
 
-    def build_graph(self):
-        from ..frontend import generators
-        from ..frontend.gpt2_dag import build_gpt2_dag
-        from ..models.gpt2 import GPT2Config
-
+    def _model_family(self):
+        """(variants, layers_field, max_seq_field, builder) for real model
+        families, or None for synthetic workloads.  One table so every
+        family shares the same variant lookup / num_layers override /
+        seq-len clamp behavior."""
         if self.model.startswith("gpt2"):
-            maker = {
-                "gpt2": GPT2Config.small,
-                "gpt2-medium": GPT2Config.medium,
-                "gpt2-tiny": GPT2Config.tiny,
-            }.get(self.model)
-            if maker is None:
-                raise ValueError(
-                    f"unknown model {self.model!r}; gpt2 variants are "
-                    "gpt2 / gpt2-medium / gpt2-tiny"
-                )
-            cfg = maker()
-            if self.num_layers:
-                cfg = dataclasses.replace(cfg, n_layer=self.num_layers)
-            seq = min(self.seq_len, cfg.n_positions)
-            return build_gpt2_dag(
-                cfg, batch=self.batch, seq_len=seq,
-                microbatches=self.microbatches,
+            from ..frontend.gpt2_dag import build_gpt2_dag
+            from ..models.gpt2 import GPT2Config
+
+            return (
+                {
+                    "gpt2": GPT2Config.small,
+                    "gpt2-medium": GPT2Config.medium,
+                    "gpt2-tiny": GPT2Config.tiny,
+                },
+                "n_layer", "n_positions", build_gpt2_dag,
             )
         if self.model.startswith("llama"):
             from ..frontend.llama_dag import build_llama_dag
             from ..models.llama import LlamaConfig
 
-            maker = {
-                "llama": LlamaConfig.llama3_8b,
-                "llama-8b": LlamaConfig.llama3_8b,
-                "llama-tiny": LlamaConfig.tiny,
-            }.get(self.model)
+            return (
+                {
+                    "llama": LlamaConfig.llama3_8b,
+                    "llama-8b": LlamaConfig.llama3_8b,
+                    "llama-tiny": LlamaConfig.tiny,
+                },
+                "n_layers", "max_seq_len", build_llama_dag,
+            )
+        if self.model.startswith("mixtral"):
+            from ..frontend.moe_dag import build_moe_dag
+            from ..models.mixtral import MixtralConfig
+
+            return (
+                {
+                    "mixtral": MixtralConfig.mixtral_8x7b,
+                    "mixtral-8x7b": MixtralConfig.mixtral_8x7b,
+                    "mixtral-tiny": MixtralConfig.tiny,
+                },
+                "n_layers", "max_seq_len", build_moe_dag,
+            )
+        return None
+
+    def build_graph(self):
+        from ..frontend import generators
+
+        if self.train_step and not self.model.startswith("gpt2"):
+            raise ValueError(
+                "--train-step currently supports gpt2* models only"
+            )
+        if self.train_step and self.microbatches != 1:
+            raise ValueError(
+                "--train-step does not support --microbatches yet"
+            )
+
+        family = self._model_family()
+        if family is not None:
+            variants, layers_field, max_seq_field, builder = family
+            maker = variants.get(self.model)
             if maker is None:
                 raise ValueError(
-                    f"unknown model {self.model!r}; llama variants are "
-                    "llama / llama-8b / llama-tiny"
+                    f"unknown model {self.model!r}; variants are "
+                    f"{' / '.join(sorted(variants))}"
                 )
             cfg = maker()
             if self.num_layers:
-                cfg = dataclasses.replace(cfg, n_layers=self.num_layers)
-            seq = min(self.seq_len, cfg.max_seq_len)
-            return build_llama_dag(
+                cfg = dataclasses.replace(cfg, **{layers_field: self.num_layers})
+            seq = min(self.seq_len, getattr(cfg, max_seq_field))
+            if self.train_step:
+                from ..frontend.train_dag import build_gpt2_train_dag
+
+                return build_gpt2_train_dag(cfg, batch=self.batch, seq_len=seq)
+            return builder(
                 cfg, batch=self.batch, seq_len=seq,
                 microbatches=self.microbatches,
             )
@@ -103,8 +134,8 @@ class RunConfig:
         }
         if self.model not in makers:
             raise ValueError(
-                f"unknown model {self.model!r}; choose gpt2 / gpt2-medium / "
-                "gpt2-tiny / llama / llama-8b / llama-tiny / llm / random / "
+                f"unknown model {self.model!r}; choose gpt2[-medium|-tiny] / "
+                "llama[-8b|-tiny] / mixtral[-8x7b|-tiny] / llm / random / "
                 "pipeline"
             )
         return makers[self.model]()
