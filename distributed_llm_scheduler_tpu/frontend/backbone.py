@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.graph import Task, TaskGraph
-from .gpt2_dag import ModelDAG, _bytes_of, _GB
+from .gpt2_dag import ModelDAG, make_task_adder
 
 # ffn_section(add, mb, layer, ffn_norm_tid, group) -> FFN output task id
 FfnSection = Callable[[Callable[..., None], str, int, str, str], str]
@@ -63,29 +63,7 @@ def build_decoder_dag(
 
     tasks: List[Task] = []
     out_specs: Dict[str, Any] = {}
-
-    def add(tid, fn, deps, alias, flops, group):
-        dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
-        pspec = {loc: specs[glob] for loc, glob in alias.items()}
-        out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
-        out_specs[tid] = out
-        globals_ = list(alias.values())
-        tasks.append(
-            Task(
-                tid,
-                memory_required=_bytes_of(out) / _GB,
-                compute_time=max(flops / effective_flops, 1e-7),
-                dependencies=list(deps),
-                params_needed=set(globals_),
-                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
-                fn=fn,
-                arg_tasks=list(deps),
-                param_alias=dict(alias),
-                out_shape=out,
-                flops=flops,
-                group=group,
-            )
-        )
+    add = make_task_adder(tasks, out_specs, specs, input_spec, effective_flops)
 
     # ---- shared task fns: fn(params_dict, *dep_outputs) ------------------
     def make_f_embedding(lo, hi):

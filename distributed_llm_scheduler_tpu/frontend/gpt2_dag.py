@@ -74,13 +74,68 @@ class ModelDAG:
 
 
 def _bytes_of(spec: Any) -> int:
-    size = 1
-    for s in spec.shape:
-        size *= s
-    return size * jnp.dtype(spec.dtype).itemsize
+    """Total bytes of a spec pytree (single ShapeDtypeStruct or any nest —
+    train-DAG tasks output dicts of arrays)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(spec):
+        size = 1
+        for s in leaf.shape:
+            size *= s
+        total += size * jnp.dtype(leaf.dtype).itemsize
+    return total
 
 
 _GB = 1024**3
+
+
+def make_task_adder(
+    tasks: List["Task"],
+    out_specs: Dict[str, Any],
+    specs: Dict[str, Any],
+    input_spec: Any,
+    effective_flops: float,
+) -> Callable[..., None]:
+    """The one task-construction closure every frontend builder shares.
+
+    Returns ``add(tid, fn, deps, alias, flops, group)``: infers the task's
+    output spec with ``jax.eval_shape`` chained through ``out_specs``,
+    computes real activation/param byte sizes, and appends a fully-wired
+    :class:`Task`.  ``alias`` maps fn-local param names -> global param
+    names; structurally identical tasks (every layer's ln1, ...) share ONE
+    fn object so jit compiles each op shape once, not once per layer.
+    """
+
+    def add(
+        tid: str,
+        fn: Callable[..., Any],
+        deps: List[str],
+        alias: Dict[str, str],
+        flops: float,
+        group: str,
+    ) -> None:
+        dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
+        pspec = {loc: specs[glob] for loc, glob in alias.items()}
+        out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
+        out_specs[tid] = out
+        globals_ = list(alias.values())
+        tasks.append(
+            Task(
+                tid,
+                memory_required=_bytes_of(out) / _GB,
+                compute_time=max(flops / effective_flops, 1e-7),
+                dependencies=list(deps),
+                params_needed=set(globals_),
+                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
+                fn=fn,
+                arg_tasks=list(deps),
+                param_alias=dict(alias),
+                out_shape=out,
+                flops=flops,
+                group=group,
+            )
+        )
+
+    return add
 
 
 def build_gpt2_dag(
@@ -123,40 +178,7 @@ def build_gpt2_dag(
     tasks: List[Task] = []
     # running map of task_id -> output spec, for eval_shape chaining
     out_specs: Dict[str, Any] = {}
-
-    def add(
-        tid: str,
-        fn: Callable[..., Any],
-        deps: List[str],
-        alias: Dict[str, str],
-        flops: float,
-        group: str,
-    ) -> None:
-        """Register a task.  ``alias`` maps fn-local param names -> global
-        param names; structurally identical tasks (every layer's ln1, ...)
-        share ONE fn object so jit compiles each op shape once, not once
-        per layer."""
-        dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
-        pspec = {loc: specs[glob] for loc, glob in alias.items()}
-        out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
-        out_specs[tid] = out
-        globals_ = list(alias.values())
-        tasks.append(
-            Task(
-                tid,
-                memory_required=_bytes_of(out) / _GB,
-                compute_time=max(flops / effective_flops, 1e-7),
-                dependencies=list(deps),
-                params_needed=set(globals_),
-                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
-                fn=fn,
-                arg_tasks=list(deps),
-                param_alias=dict(alias),
-                out_shape=out,
-                flops=flops,
-                group=group,
-            )
-        )
+    add = make_task_adder(tasks, out_specs, specs, input_spec, effective_flops)
 
     # ---- task fns: fn(params_dict, *dep_outputs), local param names ------
     def make_f_embedding(lo, hi):

@@ -35,8 +35,10 @@ def build_llama_dag(
 ) -> ModelDAG:
     """Build the per-op forward DAG for a Llama config."""
     config = config or LlamaConfig.llama3_8b()
+    if microbatches < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
     D, F = config.d_model, config.ffn_hidden
-    Bm = (batch // microbatches) if microbatches else batch
+    Bm = batch // microbatches
     T = seq_len
 
     def f_gate(p, x):

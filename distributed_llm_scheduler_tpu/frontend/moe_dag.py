@@ -37,9 +37,11 @@ def build_moe_dag(
     """Build the per-op forward DAG for a Mixtral config, one task per
     expert."""
     config = config or MixtralConfig.mixtral_8x7b()
+    if microbatches < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
     D, F = config.d_model, config.ffn_hidden
     E, K = config.n_experts, config.top_k
-    Bm = (batch // microbatches) if microbatches else batch
+    Bm = batch // microbatches
     T = seq_len
 
     def f_router(p, x):

@@ -38,11 +38,7 @@ import jax.numpy as jnp
 from ..core.graph import Task, TaskGraph
 from ..models import gpt2
 from ..models.gpt2 import GPT2Config
-from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, _bytes_of, _GB
-
-
-def _bytes_tree(out: Any) -> int:
-    return sum(_bytes_of(l) for l in jax.tree_util.tree_leaves(out))
+from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, make_task_adder
 
 
 class TrainDAG(ModelDAG):
@@ -95,29 +91,7 @@ def build_gpt2_train_dag(
 
     tasks: List[Task] = []
     out_specs: Dict[str, Any] = {}
-
-    def add(tid, fn, deps, alias, flops, group):
-        dep_specs = [out_specs[d] for d in deps] if deps else [input_spec]
-        pspec = {loc: specs[glob] for loc, glob in alias.items()}
-        out = jax.eval_shape(lambda pd, *a: fn(pd, *a), pspec, *dep_specs)
-        out_specs[tid] = out
-        globals_ = list(alias.values())
-        tasks.append(
-            Task(
-                tid,
-                memory_required=_bytes_tree(out) / _GB,
-                compute_time=max(flops / effective_flops, 1e-7),
-                dependencies=list(deps),
-                params_needed=set(globals_),
-                param_bytes={g: _bytes_of(specs[g]) for g in globals_},
-                fn=fn,
-                arg_tasks=list(deps),
-                param_alias=dict(alias),
-                out_shape=out,
-                flops=flops,
-                group=group,
-            )
-        )
+    add = make_task_adder(tasks, out_specs, specs, input_spec, effective_flops)
 
     # ---- model pieces ----------------------------------------------------
     def layer_fwd(p: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
@@ -251,7 +225,8 @@ def build_gpt2_train_dag(
             ), f"layer_{i}")
         opt_ids.append(tid)
     add("opt_embed", f_opt_embed, ["embedding_bwd", "head_bwd"],
-        {"wte": "wte", "wpe": "wpe"}, 2.0 * (V + T) * D, "embed")
+        {"wte": "wte", "wpe": "wpe"},
+        2.0 * (V + config.n_positions) * D, "embed")
     opt_ids.append("opt_embed")
     add("opt_head", f_opt_head, ["head_bwd"],
         {"ln_f_g": "ln_f_g", "ln_f_b": "ln_f_b"}, 4.0 * D, "head")

@@ -28,6 +28,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.attention import mha as _fused_mha
+
 
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
@@ -148,12 +150,8 @@ def causal_attention(
         return t.reshape(B, T, n_head, hd).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-    i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
-    j = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
-    scores = jnp.where(j <= i, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    # fused flash-attention kernel on TPU, plain-XLA path elsewhere (ops/)
+    out = _fused_mha(q, k, v, causal=True)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
     return out @ proj_w + proj_b
 

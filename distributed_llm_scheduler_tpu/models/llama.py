@@ -27,6 +27,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.attention import gqa_mha as _fused_gqa
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -153,7 +155,6 @@ def gqa_attention(
     DAG (reference test_gpt2.py:75-90 puts qkv+proj on a single task)."""
     B, T, D = x.shape
     hd = wq.shape[-1] // n_heads
-    group = n_heads // n_kv_heads
 
     q = (x @ wq).reshape(B, T, n_heads, hd).transpose(0, 2, 1, 3)
     k = (x @ wk).reshape(B, T, n_kv_heads, hd).transpose(0, 2, 1, 3)
@@ -163,16 +164,10 @@ def gqa_attention(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    # broadcast KV heads across their query group (GQA): (B, nkv, T, hd) ->
-    # (B, nkv, group, T, hd); einsum contracts per (kv-head, group) pair
-    qg = q.reshape(B, n_kv_heads, group, T, hd)
-    scores = jnp.einsum("bkgqd,bkld->bkgql", qg, k) / math.sqrt(hd)
-    i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
-    j = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
-    scores = jnp.where(j <= i, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    out = jnp.einsum("bkgql,bkld->bkgqd", probs, v)
-    out = out.reshape(B, n_heads, T, hd).transpose(0, 2, 1, 3).reshape(B, T, D)
+    # fused flash-attention kernel on TPU (KV heads broadcast across their
+    # query group inside gqa_mha), plain-XLA path elsewhere (ops/)
+    out = _fused_gqa(q, k, v, causal=True)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
     return out @ wo
 
 

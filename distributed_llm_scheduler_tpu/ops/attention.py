@@ -1,0 +1,241 @@
+"""Fused causal flash attention as a Pallas TPU kernel.
+
+The hot op of every model family (SURVEY.md §7 "hot parts"): materializing
+the (T, T) score matrix costs O(T^2) HBM traffic, which at long context is
+the bandwidth bottleneck.  This kernel streams K/V blocks through VMEM with
+an online-softmax accumulator (running max / denominator), so scores never
+leave VMEM and HBM traffic is O(T · d).  The same math drives the ring
+attention loop in :mod:`..parallel.ring_attention` — there blocks rotate
+across chips over ICI; here they stream within one chip's HBM→VMEM.
+
+Layout: grid (batch·heads, Q blocks); per grid step one Q block lives in
+VMEM while the kernel walks K/V blocks with ``lax.fori_loop``.  Causality
+prunes the loop: Q block ``i`` only visits K/V blocks ``0..i`` (the trip
+count is a traced value — Pallas lowers it to a hardware loop, no
+recompilation per block).  Scores/accumulators are float32 for stability;
+inputs/outputs stay in the model dtype (bfloat16 on TPU hits the MXU).
+
+``mha`` is the public entry: it dispatches to the kernel on TPU (or
+interpreter mode for CPU tests) and to a plain-XLA reference elsewhere, so
+models can call it unconditionally.
+
+The reference never executes attention (its "attention" is a DAG node with
+a cost constant, reference ``test_gpt2.py:75-90``); this file exists
+because the rebuild executes for real.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block, causal):
+    """One (batch·head, q-block) grid step.
+
+    q_ref/o_ref: (1, block, hd) VMEM; k_ref/v_ref: (1, T, hd) VMEM.
+    """
+    q_blk = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block, hd)
+    hd = q.shape[-1]
+    T = k_ref.shape[1]
+    n_blocks = T // block
+
+    q_start = q_blk * block
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0) + q_start
+
+    def body(kv_i, carry):
+        acc, m, l = carry
+        kv_start = kv_i * block
+        k = k_ref[0, pl.ds(kv_start, block), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kv_start, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block, block)
+        if causal:
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1) + kv_start
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((block, hd), jnp.float32)
+    m0 = jnp.full((block, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block, 1), jnp.float32)
+    # causal: Q block i needs K/V blocks 0..i only (diagonal always has the
+    # self-position, so no row is ever fully masked and l stays positive)
+    trip = jnp.where(causal, q_blk + 1, n_blocks) if causal else n_blocks
+    acc, _, l = jax.lax.fori_loop(0, trip, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _pick_block(T: int) -> int:
+    """Largest power-of-two divisor of T capped at 512 (MXU-friendly)."""
+    block = 1
+    while block < 512 and T % (block * 2) == 0:
+        block *= 2
+    return block
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_with_vjp(causal: bool, sm_scale: float, block: int, interpret: bool):
+    """Differentiable flash forward: pallas_call has no autodiff rule, so
+    training-step DAGs (``frontend/train_dag.py``) would crash under
+    ``jax.vjp`` exactly on TPU where the kernel is selected.  The backward
+    recomputes attention through the XLA reference path (flash-style
+    rematerialization: residuals are just q/k/v, no O(T^2) tensor is saved
+    between fwd and bwd).  Cached per static config so jit sees one stable
+    function object per shape family (no retrace churn)."""
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _flash_mha(
+            q, k, v, causal=causal, sm_scale=sm_scale, block=block,
+            interpret=interpret,
+        )
+
+    def f_fwd(q, k, v):
+        out = _flash_mha(
+            q, k, v, causal=causal, sm_scale=sm_scale, block=block,
+            interpret=interpret,
+        )
+        return out, (q, k, v)
+
+    def f_bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: reference_mha(
+                q_, k_, v_, causal=causal, sm_scale=sm_scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block", "interpret")
+)
+def _flash_mha(q, k, v, *, causal, sm_scale, block, interpret):
+    B, H, T, hd = q.shape
+    flat = lambda t: t.reshape(B * H, T, hd)
+    grid = (B * H, T // block)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, sm_scale=sm_scale, block=block, causal=causal
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, hd), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(flat(q), flat(k), flat(v))
+    return out.reshape(B, H, T, hd)
+
+
+def reference_mha(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
+    """Plain-XLA oracle: same contract as :func:`mha`, O(T^2) memory."""
+    hd = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[-2]
+        i = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        j = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        scores = jnp.where(j <= i, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _auto_impl() -> str:
+    forced = os.environ.get("DLS_TPU_ATTENTION_IMPL")
+    if forced:
+        return forced
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:  # pragma: no cover - backend init failure
+        platform = "cpu"
+    return "pallas" if (platform == "tpu" and _HAS_PLTPU) else "xla"
+
+
+def pallas_supported(q_shape, block_min: int = 8) -> bool:
+    """Kernel preconditions: T divisible by a tile-worthy block."""
+    T = q_shape[-2]
+    return T >= 2 * block_min and _pick_block(T) >= block_min
+
+
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Multi-head attention on (B, H, T, hd) tensors.
+
+    impl: "pallas" (TPU kernel), "pallas_interpret" (CPU-debuggable kernel),
+    "xla" (reference einsum path), or None = auto (pallas on TPU when the
+    shape qualifies, xla otherwise).
+    """
+    if impl is None:
+        impl = _auto_impl()
+    if impl.startswith("pallas") and not pallas_supported(q.shape):
+        impl = "xla"
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if impl == "pallas" or impl == "pallas_interpret":
+        return _flash_with_vjp(
+            causal,
+            float(scale),
+            _pick_block(q.shape[-2]),
+            impl == "pallas_interpret",
+        )(q, k, v)
+    if impl == "xla":
+        return reference_mha(q, k, v, causal=causal, sm_scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def gqa_mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Grouped-query attention: q (B, Hq, T, hd), k/v (B, Hkv, T, hd) with
+    Hq a multiple of Hkv.  KV heads are broadcast across their query group
+    (an O(T·d) repeat — negligible next to the O(T^2) attention savings)."""
+    Hq, Hkv = q.shape[1], k.shape[1]
+    if Hq != Hkv:
+        group = Hq // Hkv
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    return mha(q, k, v, causal=causal, sm_scale=sm_scale, impl=impl)

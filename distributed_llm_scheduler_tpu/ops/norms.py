@@ -1,0 +1,144 @@
+"""Fused LayerNorm / RMSNorm as Pallas TPU kernels.
+
+Normalizations are pure HBM-bandwidth ops (read x, write x-shaped output);
+the win is one pass over memory with the mean/variance/scale math fused on
+the VPU, float32 accumulation regardless of the model dtype.  XLA usually
+fuses these well on its own — the kernels exist so the DAG frontend's
+per-op task functions have a hand-tuned path on TPU (and to demonstrate
+the VMEM row-block pattern the guide recommends for elementwise+reduce).
+
+Grid: 1-D over row blocks of the flattened (rows, D) input; each step
+normalizes ``block_rows`` rows held in VMEM.  ``layer_norm``/``rms_norm``
+dispatch the same way :func:`..ops.attention.mha` does: Pallas on TPU,
+interpret mode for CPU tests, plain-XLA fallback otherwise.
+
+Reference parity: the reference's DAG has ln1/ln2/final-ln tasks as cost
+constants only (reference ``test_gpt2.py:63-74,101-110,151-157``); these
+are their executable TPU forms.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mean = x.mean(axis=-1, keepdims=True)
+    xc = x - mean
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps)
+    g = g_ref[...].astype(jnp.float32)
+    b = b_ref[...].astype(jnp.float32)
+    o_ref[...] = (y * g + b).astype(o_ref.dtype)
+
+
+def _rms_kernel(x_ref, g_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    scale = jax.lax.rsqrt((x * x).mean(axis=-1, keepdims=True) + eps)
+    g = g_ref[...].astype(jnp.float32)
+    o_ref[...] = (x * scale * g).astype(o_ref.dtype)
+
+
+def _pick_rows(rows: int, cap: int = 256) -> int:
+    block = 1
+    while block < cap and rows % (block * 2) == 0:
+        block *= 2
+    return block
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _ln_pallas(x2d, g, b, *, eps, interpret):
+    rows, D = x2d.shape
+    block = _pick_rows(rows)
+    return pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, D), x2d.dtype),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, D), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x2d, g, b)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _rms_pallas(x2d, g, *, eps, interpret):
+    rows, D = x2d.shape
+    block = _pick_rows(rows)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, D), x2d.dtype),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, D), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x2d, g)
+
+
+def _auto_impl() -> str:
+    forced = os.environ.get("DLS_TPU_NORM_IMPL")
+    if forced:
+        return forced
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:  # pragma: no cover
+        platform = "cpu"
+    return "pallas" if platform == "tpu" else "xla"
+
+
+def layer_norm(
+    x: jax.Array,
+    g: jax.Array,
+    b: jax.Array,
+    eps: float = 1e-5,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """LayerNorm over the last axis of x (any leading shape)."""
+    if impl is None:
+        impl = _auto_impl()
+    if impl == "xla" or x.shape[-1] != g.shape[-1] or x.size == 0:
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + eps)
+        return (out * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+    lead = x.shape[:-1]
+    out = _ln_pallas(
+        x.reshape(-1, x.shape[-1]), g, b,
+        eps=eps, interpret=(impl == "pallas_interpret"),
+    )
+    return out.reshape(*lead, x.shape[-1])
+
+
+def rms_norm(
+    x: jax.Array,
+    g: jax.Array,
+    eps: float = 1e-5,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """RMSNorm over the last axis of x (any leading shape)."""
+    if impl is None:
+        impl = _auto_impl()
+    if impl == "xla" or x.shape[-1] != g.shape[-1] or x.size == 0:
+        xf = x.astype(jnp.float32)
+        scale = jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+        return (xf * scale * g.astype(jnp.float32)).astype(x.dtype)
+    lead = x.shape[:-1]
+    out = _rms_pallas(
+        x.reshape(-1, x.shape[-1]), g,
+        eps=eps, interpret=(impl == "pallas_interpret"),
+    )
+    return out.reshape(*lead, x.shape[-1])
