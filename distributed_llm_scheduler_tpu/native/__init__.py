@@ -1,0 +1,119 @@
+"""Native engine loader: builds + binds the C++ list-scheduling engine.
+
+The engine (``engine.cpp``) is compiled lazily with the system ``g++`` into a
+content-addressed shared library under ``_build/`` the first time it's needed
+(no pip/pybind11 dependency — plain ctypes over a C ABI).  If no working
+compiler is available the loader reports unavailability and every caller falls
+back to the pure-Python policies, which remain the reference semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+from typing import Optional
+
+_HERE = Path(__file__).resolve().parent
+_SOURCE = _HERE / "engine.cpp"
+_BUILD_DIR = _HERE / "_build"
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_engine: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+POLICY_IDS = {
+    "roundrobin": 0,
+    "dfs": 1,
+    "greedy": 2,
+    "critical": 3,
+    "mru": 4,
+    "heft": 5,
+}
+
+
+def _so_path() -> Path:
+    digest = hashlib.sha256(_SOURCE.read_bytes()).hexdigest()[:12]
+    return _BUILD_DIR / f"engine_{digest}.so"
+
+
+def _compile(so: Path) -> None:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    tmp = so.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-std=c++17", "-fPIC", "-shared",
+        str(_SOURCE), "-o", str(tmp),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        tmp.unlink(missing_ok=True)  # partial output from a failed compile
+        detail = getattr(e, "stderr", "") or str(e)
+        raise RuntimeError(f"native engine build failed: {detail}") from e
+    os.replace(tmp, so)  # atomic: concurrent builders race benignly
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.dls_schedule.restype = ctypes.c_int
+    lib.dls_schedule.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        f64p, f64p,            # task_mem, task_time
+        i32p, i32p,            # dep_off, dep_ids
+        i32p, i32p,            # par_off, par_ids
+        f64p, f64p, f64p,      # param_gb, node_mem, node_speed
+        f64p,                  # link3
+        i32p, i32p, i32p,      # out_assign, out_order, out_n_assigned
+    ]
+    lib.dls_abi_version.restype = ctypes.c_int
+    lib.dls_abi_version.argtypes = []
+    return lib
+
+
+def load_engine() -> ctypes.CDLL:
+    """The bound engine library; compiles on first call.  Raises on failure
+    (callers wanting graceful fallback use :func:`available`)."""
+    global _engine, _load_error
+    with _lock:
+        if _engine is not None:
+            return _engine
+        if _load_error is not None:
+            raise RuntimeError(_load_error)
+        so = _so_path()
+        try:
+            if not so.exists():
+                _compile(so)
+            lib = _bind(ctypes.CDLL(str(so)))
+            got = lib.dls_abi_version()
+            if got != _ABI_VERSION:
+                raise RuntimeError(
+                    f"native engine ABI {got} != expected {_ABI_VERSION}"
+                )
+            _engine = lib
+            return lib
+        except Exception as e:  # record, so we don't retry every call
+            _load_error = str(e)
+            raise
+
+
+def available() -> bool:
+    """True if the native engine can be (or already was) loaded."""
+    try:
+        load_engine()
+        return True
+    except Exception:
+        return False
+
+
+def load_error() -> Optional[str]:
+    return _load_error

@@ -1,0 +1,577 @@
+// Native list-scheduling engine.
+//
+// Implements the memory-constrained list-scheduling state machine and all six
+// placement policies (roundrobin / dfs / greedy / critical / mru / heft) over
+// a flattened, integer-indexed task graph.  Semantics are an exact mirror of
+// the Python policies in ../sched/{base,policies,heft}.py — which themselves
+// mirror the reference's observed behavior (reference schedulers.py:31-525) —
+// so the Python suite's parity tests can assert identical schedules.  The
+// engine exists because scheduling wall-time is a first-class reported metric
+// (reference simulation.py:327-333); on multi-thousand-task DAGs
+// (microbatched Llama-3 graphs) the O(rounds x ready x nodes x params) loops
+// dominate in Python and drop ~20-100x here.
+//
+// C ABI only (called via ctypes): one entry point, flat arrays in, flat
+// arrays out.  No allocation sharing with Python; no exceptions cross the
+// boundary.  Determinism contract: every sort is stable, every arg-max/min
+// keeps the first best, dependents lists are built in task-index order, and
+// parameter ids are assigned by sorted name on the Python side so id order ==
+// name order.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Graph {
+  int n_tasks, n_params, n_nodes;
+  const double* task_mem;    // [n_tasks] activation GB
+  const double* task_time;   // [n_tasks] compute seconds at speed 1.0
+  const int32_t* dep_off;    // [n_tasks+1] CSR offsets into dep_ids
+  const int32_t* dep_ids;    // dependencies, task indices
+  const int32_t* par_off;    // [n_tasks+1] CSR offsets into par_ids
+  const int32_t* par_ids;    // params needed, ascending (== name order)
+  const double* param_gb;    // [n_params]
+  const double* node_mem;    // [n_nodes] total GB
+  const double* node_speed;  // [n_nodes]
+
+  // derived
+  std::vector<int32_t> dpt_off, dpt_ids;  // dependents CSR, built like Python
+
+  int ndeps(int t) const { return dep_off[t + 1] - dep_off[t]; }
+  int nparams(int t) const { return par_off[t + 1] - par_off[t]; }
+
+  void build_dependents() {
+    // mirror TaskGraph.freeze(): for t in insertion order, for d in t.deps:
+    // dependents[d].append(t) — CSR via counting sort keeps that order.
+    std::vector<int32_t> cnt(n_tasks, 0);
+    for (int t = 0; t < n_tasks; ++t)
+      for (int k = dep_off[t]; k < dep_off[t + 1]; ++k) cnt[dep_ids[k]]++;
+    dpt_off.assign(n_tasks + 1, 0);
+    for (int t = 0; t < n_tasks; ++t) dpt_off[t + 1] = dpt_off[t] + cnt[t];
+    dpt_ids.assign(dpt_off[n_tasks], 0);
+    std::vector<int32_t> cur(dpt_off.begin(), dpt_off.end() - 1);
+    for (int t = 0; t < n_tasks; ++t)
+      for (int k = dep_off[t]; k < dep_off[t + 1]; ++k)
+        dpt_ids[cur[dep_ids[k]]++] = t;
+  }
+
+  // Kahn's algorithm, stable w.r.t. task index (== insertion) order; mirrors
+  // TaskGraph._toposort.  Graph is pre-validated on the Python side.
+  std::vector<int32_t> toposort() const {
+    std::vector<int32_t> indeg(n_tasks), order;
+    order.reserve(n_tasks);
+    for (int t = 0; t < n_tasks; ++t) indeg[t] = ndeps(t);
+    for (int t = 0; t < n_tasks; ++t)
+      if (indeg[t] == 0) order.push_back(t);
+    for (size_t i = 0; i < order.size(); ++i) {
+      int tid = order[i];
+      for (int k = dpt_off[tid]; k < dpt_off[tid + 1]; ++k)
+        if (--indeg[dpt_ids[k]] == 0) order.push_back(dpt_ids[k]);
+    }
+    return order;
+  }
+};
+
+// Mutable run state: mirrors SchedulerRun + DeviceState fields the policies
+// read.  Param residency is a dense bitmap (node-major) — the Python sets'
+// semantics with O(1) membership.
+struct Run {
+  const Graph& g;
+  std::vector<double> avail;          // [n_nodes] available GB
+  std::vector<uint8_t> cached;        // [n_nodes * n_params]
+  std::vector<int32_t> completed_on;  // [n_nodes] completed-task count
+  std::vector<uint8_t> pending, completed, failed;  // [n_tasks]
+  std::vector<int32_t> assign;        // [n_tasks] node or -1
+  std::vector<int32_t> order;         // assignment order (task ids)
+  int n_pending;
+
+  explicit Run(const Graph& graph) : g(graph) {
+    avail.assign(g.node_mem, g.node_mem + g.n_nodes);
+    cached.assign((size_t)g.n_nodes * g.n_params, 0);
+    completed_on.assign(g.n_nodes, 0);
+    pending.assign(g.n_tasks, 1);
+    completed.assign(g.n_tasks, 0);
+    failed.assign(g.n_tasks, 0);
+    assign.assign(g.n_tasks, -1);
+    order.reserve(g.n_tasks);
+    n_pending = g.n_tasks;
+  }
+
+  uint8_t& is_cached(int node, int param) {
+    return cached[(size_t)node * g.n_params + param];
+  }
+
+  bool ready(int t) const {
+    for (int k = g.dep_off[t]; k < g.dep_off[t + 1]; ++k)
+      if (!completed[g.dep_ids[k]]) return false;
+    return true;
+  }
+
+  // BaseScheduler.memory_requirement: activation + uncached param GB.
+  double mem_requirement(int t, int node) {
+    double need = g.task_mem[t];
+    for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
+      if (!is_cached(node, g.par_ids[k])) need += g.param_gb[g.par_ids[k]];
+    return need;
+  }
+
+  bool can_fit(int t, int node) {
+    return mem_requirement(t, node) <= avail[node] + 1e-9;
+  }
+
+  // BaseScheduler.assign + complete: load params (permanent debit until
+  // eviction), debit-then-credit the activation, mark completed.
+  void do_assign(int t, int node) {
+    for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k) {
+      int p = g.par_ids[k];
+      if (!is_cached(node, p)) {
+        is_cached(node, p) = 1;
+        avail[node] -= g.param_gb[p];
+      }
+    }
+    avail[node] -= g.task_mem[t];
+    order.push_back(t);
+    pending[t] = 0;
+    --n_pending;
+    // complete_task
+    avail[node] += g.task_mem[t];
+    completed[t] = 1;
+    completed_on[node]++;
+    assign[t] = node;
+  }
+
+  void do_fail(int t) {
+    pending[t] = 0;
+    --n_pending;
+    failed[t] = 1;
+  }
+
+  void fail_all_pending() {
+    for (int t = 0; t < g.n_tasks; ++t)
+      if (pending[t]) do_fail(t);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Round-loop policies (BaseScheduler._round_loop skeleton).  OrderFn sorts the
+// ready list in place; PickFn returns the chosen node or -1 (and may mutate
+// run state — MRU eviction).  `ordered` is this round's list; picks consult it
+// with pending flags (the Python ready_ids recompute).
+// ---------------------------------------------------------------------------
+
+template <typename OrderFn, typename PickFn>
+void round_loop(Run& run, OrderFn order_fn, PickFn pick_fn) {
+  const Graph& g = run.g;
+  int max_rounds = 2 * g.n_tasks, rounds = 0;
+  std::vector<int32_t> ready;
+  while (run.n_pending > 0 && rounds < max_rounds) {
+    ++rounds;
+    ready.clear();
+    for (int t = 0; t < g.n_tasks; ++t)  // insertion-order scan
+      if (run.pending[t] && run.ready(t)) ready.push_back(t);
+    if (ready.empty()) {
+      run.fail_all_pending();
+      break;
+    }
+    bool progressed = false;
+    order_fn(run, ready);
+    for (int t : ready) {
+      int node = pick_fn(run, t, ready);
+      if (node < 0) {
+        run.do_fail(t);
+      } else {
+        run.do_assign(t, node);
+        progressed = true;
+      }
+    }
+    if (!progressed && run.n_pending > 0) {
+      run.fail_all_pending();
+      break;
+    }
+  }
+}
+
+void run_roundrobin(Run& run) {
+  int cursor = 0;  // persists across rounds, like the Python closure
+  round_loop(
+      run, [](Run&, std::vector<int32_t>&) {},
+      [&cursor](Run& r, int t, const std::vector<int32_t>&) -> int {
+        int n = r.g.n_nodes;
+        for (int i = 0; i < n; ++i) {
+          int node = (cursor + i) % n;
+          if (r.can_fit(t, node)) {
+            cursor = (cursor + i + 1) % n;
+            return node;
+          }
+        }
+        return -1;
+      });
+}
+
+void run_dfs(Run& run) {
+  // DAG depth from roots, one topo pass (TaskGraph.depths)
+  const Graph& g = run.g;
+  std::vector<int32_t> depth(g.n_tasks, 0);
+  for (int tid : g.toposort()) {
+    int d = 0;
+    for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k)
+      d = std::max(d, depth[g.dep_ids[k]] + 1);
+    depth[tid] = g.ndeps(tid) ? d : 0;
+  }
+  round_loop(
+      run,
+      [&depth](Run&, std::vector<int32_t>& ready) {
+        std::stable_sort(ready.begin(), ready.end(),
+                         [&](int a, int b) { return depth[a] > depth[b]; });
+      },
+      [](Run& r, int t, const std::vector<int32_t>&) -> int {
+        int best = -1;  // most available memory; first max kept on ties
+        for (int node = 0; node < r.g.n_nodes; ++node)
+          if (r.can_fit(t, node) &&
+              (best < 0 || r.avail[node] > r.avail[best]))
+            best = node;
+        return best;
+      });
+}
+
+void run_greedy(Run& run) {
+  round_loop(
+      run, [](Run&, std::vector<int32_t>&) {},
+      [](Run& r, int t, const std::vector<int32_t>&) -> int {
+        // min (params-to-load, -available); first best kept on ties
+        int best = -1, best_load = 0;
+        for (int node = 0; node < r.g.n_nodes; ++node) {
+          if (!r.can_fit(t, node)) continue;
+          int to_load = 0;
+          for (int k = r.g.par_off[t]; k < r.g.par_off[t + 1]; ++k)
+            if (!r.is_cached(node, r.g.par_ids[k])) ++to_load;
+          if (best < 0 || to_load < best_load ||
+              (to_load == best_load && r.avail[node] > r.avail[best])) {
+            best = node;
+            best_load = to_load;
+          }
+        }
+        return best;
+      });
+}
+
+void run_critical(Run& run) {
+  // downstream critical-path length, reverse topo
+  // (TaskGraph.critical_path_lengths)
+  const Graph& g = run.g;
+  std::vector<double> cpl(g.n_tasks, 0.0);
+  std::vector<int32_t> topo = g.toposort();
+  for (auto it = topo.rbegin(); it != topo.rend(); ++it) {
+    int tid = *it;
+    double down = 0.0;
+    for (int k = g.dpt_off[tid]; k < g.dpt_off[tid + 1]; ++k)
+      down = std::max(down, cpl[g.dpt_ids[k]]);
+    cpl[tid] = g.task_time[tid] + down;
+  }
+  round_loop(
+      run,
+      [&cpl](Run&, std::vector<int32_t>& ready) {
+        std::stable_sort(ready.begin(), ready.end(),
+                         [&](int a, int b) { return cpl[a] > cpl[b]; });
+      },
+      [](Run& r, int t, const std::vector<int32_t>&) -> int {
+        // fastest fitting node, tie-broken by available memory; first max
+        int best = -1;
+        for (int node = 0; node < r.g.n_nodes; ++node) {
+          if (!r.can_fit(t, node)) continue;
+          if (best < 0 || r.g.node_speed[node] > r.g.node_speed[best] ||
+              (r.g.node_speed[node] == r.g.node_speed[best] &&
+               r.avail[node] > r.avail[best]))
+            best = node;
+        }
+        return best;
+      });
+}
+
+// MRU scoring weights, verbatim from the reference (SURVEY.md §2 #7).
+constexpr double W_FREQ = 10.0, W_RECENCY = 100.0, W_NEEDED = 1000.0;
+constexpr double W_OVERLAP = 20.0, W_FITS_AFTER_EVICT = 5.0;
+constexpr double W_LOAD_PENALTY = 0.5;
+
+void run_mru(Run& run) {
+  const Graph& g = run.g;
+  std::vector<int32_t> usage_count(g.n_params, 0);
+  std::vector<int32_t> last_used(g.n_params, INT32_MIN);  // sentinel: unseen
+  int clock = 0;
+  // param -> needed by any still-pending task in this round's ordered list;
+  // recomputed lazily per pick (the ready_ids scan in Python)
+  std::vector<uint8_t> in_task(g.n_params, 0);
+
+  auto eviction_score = [&](int p, const std::vector<int32_t>& ordered,
+                            Run& r) -> double {
+    double score = W_FREQ * usage_count[p];
+    int last = last_used[p] == INT32_MIN ? -clock : last_used[p];
+    score += W_RECENCY / ((clock - last) + 1.0);
+    for (int tid : ordered) {
+      if (!r.pending[tid]) continue;
+      for (int k = g.par_off[tid]; k < g.par_off[tid + 1]; ++k)
+        if (g.par_ids[k] == p) {
+          return score + W_NEEDED;
+        }
+    }
+    return score;
+  };
+
+  // Lowest-score-first eviction plan so `t` fits on `node`; empty if it
+  // already fits, nullopt (ok=false) if impossible.  Pure (MRUScheduler
+  // .eviction_plan — the reference's evict-during-scoring bug is fixed the
+  // same way on both sides).
+  struct Plan {
+    bool ok;
+    std::vector<int32_t> evict;
+  };
+  auto eviction_plan = [&](Run& r, int t, int node,
+                           const std::vector<int32_t>& ordered) -> Plan {
+    double need = r.mem_requirement(t, node);
+    double deficit = need - r.avail[node];
+    if (deficit <= 1e-9) return {true, {}};
+    for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
+      in_task[g.par_ids[k]] = 1;
+    std::vector<int32_t> cand;  // id order == name order
+    for (int p = 0; p < g.n_params; ++p)
+      if (r.is_cached(node, p) && !in_task[p]) cand.push_back(p);
+    for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
+      in_task[g.par_ids[k]] = 0;
+    std::vector<double> score(cand.size());
+    for (size_t i = 0; i < cand.size(); ++i)
+      score[i] = eviction_score(cand[i], ordered, r);
+    std::vector<int32_t> idx(cand.size());
+    for (size_t i = 0; i < idx.size(); ++i) idx[i] = (int32_t)i;
+    std::stable_sort(idx.begin(), idx.end(),
+                     [&](int a, int b) { return score[a] < score[b]; });
+    Plan plan{false, {}};
+    double freed = 0.0;
+    for (int i : idx) {
+      plan.evict.push_back(cand[i]);
+      freed += g.param_gb[cand[i]];
+      if (freed >= deficit - 1e-9) {
+        plan.ok = true;
+        return plan;
+      }
+    }
+    return {false, {}};
+  };
+
+  round_loop(
+      run,
+      [&g](Run& r, std::vector<int32_t>& ready) {
+        // order by number of still-pending dependents, descending
+        std::vector<int32_t> key(g.n_tasks, 0);
+        for (int t : ready) {
+          int c = 0;
+          for (int k = g.dpt_off[t]; k < g.dpt_off[t + 1]; ++k)
+            if (r.pending[g.dpt_ids[k]]) ++c;
+          key[t] = c;
+        }
+        std::stable_sort(ready.begin(), ready.end(),
+                         [&](int a, int b) { return key[a] > key[b]; });
+      },
+      [&](Run& r, int t, const std::vector<int32_t>& ordered) -> int {
+        int best = -1;
+        double best_score = 0.0;
+        Plan best_plan{false, {}};
+        for (int node = 0; node < g.n_nodes; ++node) {
+          Plan plan = eviction_plan(r, t, node, ordered);
+          if (!plan.ok) continue;
+          int overlap = 0;
+          for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
+            if (r.is_cached(node, g.par_ids[k])) ++overlap;
+          double score = W_OVERLAP * overlap + r.avail[node] +
+                         W_FITS_AFTER_EVICT -
+                         W_LOAD_PENALTY * r.completed_on[node];
+          if (best < 0 || score > best_score) {
+            best = node;
+            best_score = score;
+            best_plan = std::move(plan);
+          }
+        }
+        if (best < 0) return -1;
+        for (int p : best_plan.evict) {
+          r.is_cached(best, p) = 0;
+          r.avail[best] += g.param_gb[p];
+        }
+        for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k) {
+          usage_count[g.par_ids[k]]++;
+          last_used[g.par_ids[k]] = clock;
+        }
+        ++clock;
+        return best;
+      });
+}
+
+// ---------------------------------------------------------------------------
+// HEFT (sched/heft.py): upward ranks with mean communication, insertion-based
+// earliest-finish-time node choice, per-node host-link parameter load queues.
+// link[0]=param_load_gbps (<=0 means free), link[1]=interconnect_gbps,
+// link[2]=latency_s.
+// ---------------------------------------------------------------------------
+
+void run_heft(Run& run, const double* link) {
+  const Graph& g = run.g;
+  const double load_gbps = link[0], ici_gbps = link[1], lat = link[2];
+  auto param_load_time = [&](double gb) {
+    return load_gbps <= 0 ? 0.0 : lat + gb / load_gbps;
+  };
+  auto transfer_time = [&](double gb) {
+    return ici_gbps <= 0 ? 0.0 : lat + gb / ici_gbps;
+  };
+
+  double cross_frac = g.n_nodes > 1 ? (g.n_nodes - 1.0) / g.n_nodes : 0.0;
+  double mean_speed = 0.0;
+  for (int n = 0; n < g.n_nodes; ++n) mean_speed += g.node_speed[n];
+  mean_speed /= g.n_nodes;
+
+  std::vector<int32_t> topo = g.toposort();
+  std::vector<double> rank(g.n_tasks, 0.0);
+  for (auto it = topo.rbegin(); it != topo.rend(); ++it) {
+    int tid = *it;
+    double w = g.task_time[tid] / mean_speed;
+    double comm = cross_frac * transfer_time(g.task_mem[tid]);
+    double best_child = 0.0;
+    for (int k = g.dpt_off[tid]; k < g.dpt_off[tid + 1]; ++k)
+      best_child = std::max(best_child, comm + rank[g.dpt_ids[k]]);
+    rank[tid] = w + best_child;
+  }
+
+  std::vector<int32_t> order(g.n_tasks);
+  for (int t = 0; t < g.n_tasks; ++t) order[t] = t;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int a, int b) { return rank[a] > rank[b]; });
+
+  std::vector<std::vector<std::pair<double, double>>> busy(g.n_nodes);
+  std::vector<double> load_queue_end(g.n_nodes, 0.0);
+  std::vector<double> param_ready_at((size_t)g.n_nodes * g.n_params, 0.0);
+  std::vector<double> finish(g.n_tasks, 0.0), start_at(g.n_tasks, 0.0);
+
+  auto earliest_slot = [](const std::vector<std::pair<double, double>>& iv,
+                          double ready, double dur) {
+    double t = ready;
+    for (const auto& se : iv) {
+      if (t + dur <= se.first) return t;
+      t = std::max(t, se.second);
+    }
+    return t;
+  };
+
+  for (int tid : order) {
+    bool dep_failed = false;
+    for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k)
+      if (run.failed[g.dep_ids[k]]) dep_failed = true;
+    if (dep_failed) {
+      run.do_fail(tid);
+      continue;
+    }
+    int best = -1;
+    double best_eft = 0.0, best_start = 0.0;
+    for (int node = 0; node < g.n_nodes; ++node) {
+      if (!run.can_fit(tid, node)) continue;
+      double q_end = load_queue_end[node];
+      double ready = 0.0;
+      for (int k = g.par_off[tid]; k < g.par_off[tid + 1]; ++k) {
+        int p = g.par_ids[k];
+        if (run.is_cached(node, p)) {
+          ready =
+              std::max(ready, param_ready_at[(size_t)node * g.n_params + p]);
+        } else {
+          q_end += param_load_time(g.param_gb[p]);
+          ready = std::max(ready, q_end);
+        }
+      }
+      for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k) {
+        int d = g.dep_ids[k];
+        double arrive = finish[d];
+        if (run.assign[d] != node) arrive += transfer_time(g.task_mem[d]);
+        ready = std::max(ready, arrive);
+      }
+      double dur = g.task_time[tid] / g.node_speed[node];
+      double start = earliest_slot(busy[node], ready, dur);
+      if (best < 0 || start + dur < best_eft) {
+        best = node;
+        best_eft = start + dur;
+        best_start = start;
+      }
+    }
+    if (best < 0) {
+      run.do_fail(tid);
+      continue;
+    }
+    for (int k = g.par_off[tid]; k < g.par_off[tid + 1]; ++k) {
+      int p = g.par_ids[k];
+      if (!run.is_cached(best, p)) {
+        load_queue_end[best] += param_load_time(g.param_gb[p]);
+        param_ready_at[(size_t)best * g.n_params + p] = load_queue_end[best];
+      }
+    }
+    run.do_assign(tid, best);
+    busy[best].emplace_back(best_start, best_eft);
+    std::sort(busy[best].begin(), busy[best].end());
+    finish[tid] = best_eft;
+    start_at[tid] = best_start;
+  }
+
+  // global order by intended start time (stable: rank-order kept on ties),
+  // so a sequential per-node replay realizes the inserted interleaving
+  std::stable_sort(
+      run.order.begin(), run.order.end(),
+      [&](int a, int b) { return start_at[a] < start_at[b]; });
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; -1 on bad policy id.  out_assign[t] = node index or
+// -1 (failed); out_order = task indices in final global assignment order,
+// length = return count via *out_n_assigned.
+int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
+                 const double* task_mem, const double* task_time,
+                 const int32_t* dep_off, const int32_t* dep_ids,
+                 const int32_t* par_off, const int32_t* par_ids,
+                 const double* param_gb, const double* node_mem,
+                 const double* node_speed, const double* link3,
+                 int32_t* out_assign, int32_t* out_order,
+                 int32_t* out_n_assigned) {
+  Graph g;
+  g.n_tasks = n_tasks;
+  g.n_params = n_params;
+  g.n_nodes = n_nodes;
+  g.task_mem = task_mem;
+  g.task_time = task_time;
+  g.dep_off = dep_off;
+  g.dep_ids = dep_ids;
+  g.par_off = par_off;
+  g.par_ids = par_ids;
+  g.param_gb = param_gb;
+  g.node_mem = node_mem;
+  g.node_speed = node_speed;
+  g.build_dependents();
+
+  Run run(g);
+  switch (policy) {
+    case 0: run_roundrobin(run); break;
+    case 1: run_dfs(run); break;
+    case 2: run_greedy(run); break;
+    case 3: run_critical(run); break;
+    case 4: run_mru(run); break;
+    case 5: run_heft(run, link3); break;
+    default: return -1;
+  }
+  std::memcpy(out_assign, run.assign.data(), sizeof(int32_t) * n_tasks);
+  *out_n_assigned = (int32_t)run.order.size();
+  std::memcpy(out_order, run.order.data(),
+              sizeof(int32_t) * run.order.size());
+  return 0;
+}
+
+int dls_abi_version() { return 1; }
+
+}  // extern "C"

@@ -80,6 +80,7 @@ class HEFTScheduler(BaseScheduler):
             best: Optional[DeviceState] = None
             best_eft = float("inf")
             best_start = 0.0
+            params_sorted = sorted(task.params_needed)
             for node in cluster:
                 if not self.can_fit(run, task, node):
                     continue
@@ -88,7 +89,7 @@ class HEFTScheduler(BaseScheduler):
                 # may still be in flight from a predecessor's enqueue
                 q_end = load_queue_end[nid]
                 ready = 0.0
-                for p in task.params_needed:
+                for p in params_sorted:
                     if p in node.cached_params:
                         ready = max(ready, param_ready_at.get((nid, p), 0.0))
                     else:
@@ -112,7 +113,8 @@ class HEFTScheduler(BaseScheduler):
                 continue
 
             nid = best.node_id
-            for p in task.params_needed:
+            # name order, so each param's queued ready-time is deterministic
+            for p in params_sorted:
                 if p not in best.cached_params:
                     load_queue_end[nid] += self.link.param_load_time(
                         graph.param_size_gb(p)

@@ -179,9 +179,11 @@ class MRUScheduler(BaseScheduler):
             deficit = need - node.available_memory
             if deficit <= 1e-9:
                 return []
-            candidates = [
+            candidates = sorted(
                 p for p in node.cached_params if p not in task.params_needed
-            ]
+            )
+            # stable sort over the name-ordered list: ties break by name, so
+            # eviction order is deterministic (and native-engine parity holds)
             candidates.sort(key=lambda p: eviction_score(run, p, ready_ids))
             plan: List[Tuple[str, float]] = []
             freed = 0.0
@@ -253,9 +255,23 @@ ALL_SCHEDULERS = {
 
 
 def get_scheduler(name: str) -> BaseScheduler:
-    try:
-        return ALL_SCHEDULERS[name]()
-    except KeyError:
+    """Policy by name.  ``"native:<policy>"`` selects the C++ engine
+    explicitly; ``DLS_NATIVE=1`` upgrades every natively-supported policy
+    transparently (parity-tested: identical schedules, faster wall time)."""
+    import os
+
+    if name.startswith("native:"):
+        from .native import NativeScheduler
+
+        return NativeScheduler(name.split(":", 1)[1])
+    if name not in ALL_SCHEDULERS:
         raise ValueError(
             f"unknown scheduler {name!r}; available: {sorted(ALL_SCHEDULERS)}"
-        ) from None
+        )
+    if os.environ.get("DLS_NATIVE") == "1":
+        from .. import native as native_mod
+        from .native import NativeScheduler
+
+        if name in native_mod.POLICY_IDS and native_mod.available():
+            return NativeScheduler(name)
+    return ALL_SCHEDULERS[name]()

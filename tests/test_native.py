@@ -1,0 +1,111 @@
+"""Native C++ engine: exact-parity tests against the pure-Python policies.
+
+Every natively-implemented policy must emit bit-identical schedules (per-node
+task lists, global assignment order, completed/failed sets) to its Python
+twin across the synthetic workload families and the real GPT-2 DAG, including
+memory-constrained regimes that trigger failures and MRU eviction.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from distributed_llm_scheduler_tpu.core.cluster import (
+    Cluster,
+    estimate_cluster_memory_needed,
+)
+from distributed_llm_scheduler_tpu.frontend.generators import (
+    generate_llm_dag,
+    generate_pipeline_dag,
+    generate_random_dag,
+)
+from distributed_llm_scheduler_tpu.native import POLICY_IDS, available
+from distributed_llm_scheduler_tpu.sched.native import NativeScheduler
+from distributed_llm_scheduler_tpu.sched.policies import (
+    ALL_SCHEDULERS,
+    get_scheduler,
+)
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native engine unavailable (no g++?)"
+)
+
+NATIVE_POLICIES = sorted(POLICY_IDS)
+
+
+def make_graphs():
+    return [
+        generate_llm_dag(num_layers=4, num_heads=4, seed=7),
+        generate_llm_dag(num_layers=8, num_heads=2, seed=11),
+        generate_random_dag(num_tasks=60, seed=7),
+        generate_pipeline_dag(num_stages=5, tasks_per_stage=4, seed=7),
+    ]
+
+
+def assert_same_schedule(py, nat, label):
+    assert nat.completed == py.completed, f"{label}: completed sets differ"
+    assert nat.failed == py.failed, f"{label}: failed sets differ"
+    assert nat.per_node == py.per_node, f"{label}: per-node lists differ"
+    assert nat.assignment_order == py.assignment_order, (
+        f"{label}: assignment order differs"
+    )
+
+
+@pytest.mark.parametrize("policy", NATIVE_POLICIES)
+@pytest.mark.parametrize("regime", [1.0, 0.8, 0.5])
+def test_parity_synthetic(policy, regime):
+    for graph in make_graphs():
+        graph.freeze()
+        total = estimate_cluster_memory_needed(graph) * regime
+        for n_nodes in (2, 4):
+            py = ALL_SCHEDULERS[policy]().schedule(
+                graph, Cluster.heterogeneous(total, n_nodes)
+            )
+            nat = NativeScheduler(policy).schedule(
+                graph, Cluster.heterogeneous(total, n_nodes)
+            )
+            assert_same_schedule(
+                py, nat, f"{policy}/{graph.name}/n{n_nodes}/r{regime}"
+            )
+
+
+@pytest.mark.parametrize("policy", NATIVE_POLICIES)
+def test_parity_gpt2(policy):
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=64)
+    graph = dag.graph
+    py = ALL_SCHEDULERS[policy]().schedule(graph, Cluster.laptops())
+    nat = NativeScheduler(policy).schedule(graph, Cluster.laptops())
+    assert_same_schedule(py, nat, f"{policy}/gpt2")
+
+
+def test_parity_under_failures():
+    """A cluster too small for the DAG: failure handling must match too."""
+    graph = generate_llm_dag(num_layers=6, num_heads=4, seed=3)
+    # 1.0 GB nodes: the largest activations exceed a whole node, so even
+    # MRU's eviction cannot save everything — all policies must fail tasks
+    for policy in NATIVE_POLICIES:
+        py = ALL_SCHEDULERS[policy]().schedule(graph, Cluster.uniform(2, 1.0))
+        nat = NativeScheduler(policy).schedule(graph, Cluster.uniform(2, 1.0))
+        assert_same_schedule(py, nat, f"{policy}/too-small")
+        assert py.failed, f"{policy}: fixture should actually trigger failures"
+
+
+def test_get_scheduler_native_prefix():
+    s = get_scheduler("native:mru")
+    assert isinstance(s, NativeScheduler)
+    assert s.name == "native:mru"
+
+
+def test_env_upgrade(monkeypatch):
+    monkeypatch.setenv("DLS_NATIVE", "1")
+    assert isinstance(get_scheduler("heft"), NativeScheduler)
+    # pipeline has no native twin: falls back to Python
+    assert not isinstance(get_scheduler("pipeline"), NativeScheduler)
+
+
+def test_native_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="no native implementation"):
+        NativeScheduler("pipeline")
