@@ -17,6 +17,17 @@ scheduling → execution → evaluation/visualization), rebuilt TPU-first:
 See SURVEY.md for the layer map and parity notes.
 """
 
+import os as _os
+
+# DLS_PLATFORM=cpu|tpu pins the JAX platform before the first backend touch
+# (e.g. to keep CLI/dev runs on the host when no accelerator is reachable).
+# Must run before anything resolves a backend; importing this package first
+# is enough.
+if _os.environ.get("DLS_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["DLS_PLATFORM"])
+
 from .core.graph import (
     DEFAULT_PARAM_GB,
     GraphValidationError,

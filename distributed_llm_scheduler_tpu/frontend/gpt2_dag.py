@@ -143,6 +143,7 @@ def build_gpt2_dag(
     batch: int = 1,
     seq_len: int = 512,
     microbatches: int = 1,
+    vocab_shards: int = 1,
     effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
 ) -> ModelDAG:
     """Build the per-op forward DAG for a GPT-2 config.
@@ -157,6 +158,18 @@ def build_gpt2_dag(
     (1F1B-style overlap emerges from list scheduling); naive placement
     reloads/transfers weights per microbatch.  With ``microbatches=1`` the
     graph is the reference's 99-task shape exactly.
+
+    ``vocab_shards > 1`` splits the tied table into vocab-range row shards
+    (``wte_shard_k``) and shards BOTH of its uses — task-graph tensor
+    parallelism for the one parameter that dominates host-link load time:
+    the embedding lookup becomes per-shard partial tasks summed by a combine
+    task, and the weight-tied output projection becomes per-shard logit
+    slices concatenated along the vocab axis.  Each logit-slice task shares
+    its shard's group with the matching embedding partial, so placement
+    naturally reuses the resident shard (tying preserved per shard) and the
+    full ``wte`` table exists nowhere: its load spreads over as many device
+    queues as the scheduler parks shards on, instead of gating the whole
+    pipeline behind one sequential load.
     """
     config = config or GPT2Config.small()
     if seq_len > config.n_positions:
@@ -167,12 +180,26 @@ def build_gpt2_dag(
         raise ValueError(f"batch {batch} not divisible by microbatches {microbatches}")
     B, T, D, H, V = batch, seq_len, config.n_embd, config.n_head, config.vocab_size
     Bm = B // microbatches
+    S = vocab_shards
+    if not 1 <= S <= V:
+        raise ValueError(f"vocab_shards {S} out of range [1, {V}]")
     eps = config.ln_eps
 
     specs = {
         name: jax.ShapeDtypeStruct(shape, dtype)
         for name, (shape, dtype) in gpt2.param_shapes(config).items()
     }
+    if S > 1:
+        # balanced row split: the first V % S shards get one extra row, so
+        # every shard is non-empty for any 1 <= S <= V
+        base, extra = divmod(V, S)
+        shard_lo = [0]
+        for k in range(S):
+            shard_lo.append(shard_lo[-1] + base + (1 if k < extra else 0))
+        for k in range(S):
+            specs[f"wte_shard_{k}"] = jax.ShapeDtypeStruct(
+                (shard_lo[k + 1] - shard_lo[k], D), specs["wte"].dtype
+            )
     input_spec = jax.ShapeDtypeStruct((B, T), jnp.int32)
 
     tasks: List[Task] = []
@@ -186,6 +213,27 @@ def build_gpt2_dag(
             return gpt2.embedding(input_ids[lo:hi], p["wte"], p["wpe"])
 
         return f_embedding
+
+    def make_f_embed_partial(lo, hi, lo_v, rows):
+        """Partial lookup over one vocab-range shard of the table: rows in
+        [lo_v, lo_v+rows) contribute their embedding, others contribute 0 —
+        the shard-sum equals the full lookup exactly (each id hits exactly
+        one shard)."""
+
+        def f_embed_partial(p, input_ids):
+            local = input_ids[lo:hi] - lo_v
+            mask = (local >= 0) & (local < rows)
+            emb = p["shard"][jnp.clip(local, 0, rows - 1)]
+            return emb * mask[..., None].astype(emb.dtype)
+
+        return f_embed_partial
+
+    def f_embed_combine(p, *partials):
+        T_ = partials[0].shape[-2]
+        out = partials[0]
+        for part in partials[1:]:
+            out = out + part
+        return out + p["wpe"][:T_]
 
     def f_concat(p, *chunks):
         return jnp.concatenate(chunks, axis=0)
@@ -213,6 +261,15 @@ def build_gpt2_dag(
     def f_output_projection(p, x):
         return gpt2.output_projection(x, p["wte"])
 
+    def f_logit_shard(p, x):
+        """Logit slice via the tied table's row shard: x @ shard.T — runs
+        wherever the embedding parked that shard, so the tied table is
+        never loaded twice (nor anywhere in full)."""
+        return x @ p["shard"].T
+
+    def f_logit_concat(p, *slices):
+        return jnp.concatenate(slices, axis=-1)
+
     # ---- graph assembly (8 tasks/layer + 3 per microbatch chain,
     # reference test_gpt2.py:54-166; mb prefix only when pipelining) -------
     hd = D // H
@@ -220,8 +277,21 @@ def build_gpt2_dag(
     for m in range(microbatches):
         mb = f"mb{m}_" if microbatches > 1 else ""
         emb = f"{mb}embedding"
-        add(emb, make_f_embedding(m * Bm, (m + 1) * Bm), [],
-            {"wte": "wte", "wpe": "wpe"}, 2.0 * Bm * T * D, "embed")
+        if S > 1:
+            part_ids = []
+            for k in range(S):
+                rows = specs[f"wte_shard_{k}"].shape[0]
+                pid = f"{mb}embedding_shard_{k}"
+                add(pid,
+                    make_f_embed_partial(m * Bm, (m + 1) * Bm, shard_lo[k], rows),
+                    [], {"shard": f"wte_shard_{k}"},
+                    3.0 * Bm * T * D, f"vocab_shard_{k}")
+                part_ids.append(pid)
+            add(emb, f_embed_combine, part_ids, {"wpe": "wpe"},
+                (S + 1.0) * Bm * T * D, "embed")
+        else:
+            add(emb, make_f_embedding(m * Bm, (m + 1) * Bm), [],
+                {"wte": "wte", "wpe": "wpe"}, 2.0 * Bm * T * D, "embed")
 
         prev = emb  # residual-stream carrier entering each layer
         for i in range(config.n_layer):
@@ -269,21 +339,43 @@ def build_gpt2_dag(
         fln = f"{mb}final_ln"
         add(fln, f_ln, [prev], {"g": "ln_f_g", "b": "ln_f_b"},
             5.0 * Bm * T * D, "head")
-        # weight tying: reuses the embedding table (test_gpt2.py:160-166)
+        # weight tying: reuses the embedding table (test_gpt2.py:160-166);
+        # sharded builds tie per-shard, so the full table exists nowhere
         proj = f"{mb}output_projection"
-        add(proj, f_output_projection, [fln], {"wte": "wte"},
-            2.0 * Bm * T * D * V, "head")
+        if S > 1:
+            slice_ids = []
+            for k in range(S):
+                rows = specs[f"wte_shard_{k}"].shape[0]
+                sid = f"{mb}output_projection_shard_{k}"
+                add(sid, f_logit_shard, [fln], {"shard": f"wte_shard_{k}"},
+                    2.0 * Bm * T * D * rows, f"vocab_shard_{k}")
+                slice_ids.append(sid)
+            add(proj, f_logit_concat, slice_ids, {}, 1.0 * Bm * T * V, "head")
+        else:
+            add(proj, f_output_projection, [fln], {"wte": "wte"},
+                2.0 * Bm * T * D * V, "head")
         mb_outputs.append(proj)
 
     if microbatches > 1:
         add("output_concat", f_concat, mb_outputs, {}, 1.0 * B * T * V, "head")
 
-    # name encodes width too: cost-model caches key on graph name, and two
-    # configs with equal layer/batch/seq but different widths must not
-    # share measured timings
-    name = f"gpt2_{config.n_layer}l_d{D}_b{B}_t{T}" + (
-        f"_mb{microbatches}" if microbatches > 1 else ""
+    # name encodes width/dtype/sharding too: cost-model caches key on graph
+    # name, and two configs with equal layer/batch/seq but different widths,
+    # dtypes, or shard layouts must not share measured timings
+    dtag = "" if config.dtype == jnp.float32 else f"_{jnp.dtype(config.dtype).name}"
+    name = (
+        f"gpt2_{config.n_layer}l_d{D}_b{B}_t{T}"
+        + (f"_mb{microbatches}" if microbatches > 1 else "")
+        + (f"_vs{S}" if S > 1 else "")
+        + dtag
     )
+
+    def init_fn(key):
+        params = gpt2.init_params(config, key)
+        for k in range(S if S > 1 else 0):
+            params[f"wte_shard_{k}"] = params["wte"][shard_lo[k]:shard_lo[k + 1]]
+        return params
+
     graph = TaskGraph(tasks, name=name).freeze()
     return ModelDAG(
         graph=graph,
@@ -291,7 +383,7 @@ def build_gpt2_dag(
         input_spec=input_spec,
         param_specs=specs,
         reference_forward=partial(gpt2.forward, config=config),
-        init_fn=lambda key: gpt2.init_params(config, key),
+        init_fn=init_fn,
     )
 
 

@@ -1,0 +1,158 @@
+"""Dependency-aware per-node ordering for a fixed placement.
+
+A :class:`Schedule`'s per-node lists are executed **in order** by both
+backends (``SimulatedBackend.execute`` replays ``assignment_order``;
+``DeviceBackend`` dispatches the same way) — so a placement-correct schedule
+can still serialize terribly if its order induces head-of-line blocking: a
+task queued early on a node blocks everything behind it while it waits for a
+slow cross-node input.  Round-loop policies emit Kahn-wave order, which for
+microbatched pipeline DAGs is the worst case — every stage touches ALL
+microbatches' op *k* before any microbatch's op *k+1*, turning the pipeline
+fill into ``stages x stage_total``.
+
+:func:`dependency_aware_order` fixes the *order* without touching the
+*placement*: an event-driven simulation under the same cost model the replay
+charges (per-node serial execution, cross-node ICI transfer on dependency
+edges, prefetched parameter loads queued per node in first-use order).
+Whenever a node is free it starts the **deepest** task whose inputs have
+already arrived — depth-first within a node is what drives one microbatch
+through a whole stage before starting the next, i.e. 1F1B interleaving
+emerges from the DAG structure with no microbatch labels needed (plain
+earliest-arrival FIFO degenerates to breadth-first waves again: all roots
+arrive at t=0).  If nothing has arrived yet, the earliest-arriving task is
+taken instead, so the node never idles waiting for a "deep" input while a
+shallow one sits ready.  The returned order is sorted by simulated start
+time, the convention HEFT's insertion pass uses (sched/heft.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Tuple
+
+from ..backends.sim import LinkModel
+from ..core.graph import TaskGraph
+
+_EPS = 1e-12
+
+
+def dependency_aware_order(
+    graph: TaskGraph,
+    placement: Dict[str, str],
+    speeds: Optional[Dict[str, float]] = None,
+    link: Optional[LinkModel] = None,
+) -> List[str]:
+    """Order placed tasks to minimize head-of-line blocking.
+
+    Args:
+      graph: frozen task graph (tasks not in ``placement`` are skipped —
+        they failed placement and never become ready).
+      placement: task_id -> node_id for every placed task.
+      speeds: node_id -> compute speed (default 1.0).
+      link: cost model for cross-node dependency transfers and parameter
+        loads (defaults to :class:`LinkModel` defaults).
+
+    Returns:
+      All placed task_ids ordered by simulated start time (ties broken by
+      topological position).
+    """
+    link = link or LinkModel()
+    speeds = speeds or {}
+    topo_pos = {tid: i for i, tid in enumerate(graph.topo_order)}
+    depth = graph.depths()
+
+    # per-node ready lists: tasks whose deps all completed, with the time
+    # their last input arrives on this node
+    ready: Dict[str, List[Tuple[str, float]]] = {}
+    node_free: Dict[str, float] = {}
+    load_queue_end: Dict[str, float] = {}
+    cached: Dict[str, set] = {}
+    for nid in set(placement.values()):
+        ready[nid] = []
+        node_free[nid] = 0.0
+        load_queue_end[nid] = 0.0
+        cached[nid] = set()
+
+    missing_deps: Dict[str, int] = {}
+    arrival: Dict[str, float] = {}
+    finish: Dict[str, float] = {}
+    start_at: Dict[str, float] = {}
+
+    for tid in graph.topo_order:
+        if tid not in placement:
+            continue
+        placed_deps = [d for d in graph[tid].dependencies if d in placement]
+        missing_deps[tid] = len(placed_deps)
+        arrival[tid] = 0.0
+        if not placed_deps:
+            ready[placement[tid]].append((tid, 0.0))
+
+    # completion event queue: (finish time, topo position, tid)
+    events: List[Tuple[float, int, str]] = []
+
+    def dispatch(nid: str) -> None:
+        """If `nid` has ready work, start one task: the deepest among those
+        whose inputs arrived by the time the node frees up (1F1B), else the
+        one arriving soonest.  Params enqueue on the node's host link at
+        first use, mirroring SimulatedBackend's prefetch model."""
+        lst = ready[nid]
+        if not lst:
+            return
+        now = node_free[nid]
+        arrived = [
+            (depth[t], -topo_pos[t], i)
+            for i, (t, arr) in enumerate(lst)
+            if arr <= now + _EPS
+        ]
+        if arrived:
+            _, _, idx = max(arrived)
+        else:
+            idx = min(
+                range(len(lst)), key=lambda i: (lst[i][1], topo_pos[lst[i][0]])
+            )
+        tid, dep_ready = lst.pop(idx)
+        task = graph[tid]
+        params_ready = 0.0
+        for p in sorted(task.params_needed):
+            if p not in cached[nid]:
+                cached[nid].add(p)
+                load_queue_end[nid] += link.param_load_time(
+                    graph.param_size_gb(p)
+                )
+                params_ready = max(params_ready, load_queue_end[nid])
+        start = max(now, dep_ready, params_ready)
+        dur = task.compute_time / speeds.get(nid, 1.0)
+        start_at[tid] = start
+        finish[tid] = start + dur
+        node_free[nid] = start + dur  # node committed (serial execution)
+        heapq.heappush(events, (start + dur, topo_pos[tid], tid))
+
+    for nid in ready:
+        dispatch(nid)
+
+    while events:
+        t_done, _, tid = heapq.heappop(events)
+        nid = placement[tid]
+        for dep in graph.dependents(tid):
+            if dep not in placement or dep not in missing_deps:
+                continue
+            dep_nid = placement[dep]
+            arr = finish[tid]
+            if dep_nid != nid:
+                arr += link.transfer_time(graph[tid].memory_required)
+            arrival[dep] = max(arrival[dep], arr)
+            missing_deps[dep] -= 1
+            if missing_deps[dep] == 0:
+                ready[dep_nid].append((dep, arrival[dep]))
+                if node_free[dep_nid] <= arrival[dep]:
+                    dispatch(dep_nid)
+        dispatch(nid)  # node just freed: start its next ready task
+
+    # any still-undispatched ready tasks (nodes that went idle before work
+    # arrived): flush deterministically
+    for nid in ready:
+        while ready[nid]:
+            dispatch(nid)
+
+    placed = [tid for tid in graph.topo_order if tid in placement]
+    return sorted(placed, key=lambda t: (start_at.get(t, 0.0), topo_pos[t]))

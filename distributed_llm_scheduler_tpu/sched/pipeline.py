@@ -28,9 +28,11 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..backends.sim import LinkModel
 from ..core.cluster import DeviceState
 from ..core.graph import TaskGraph
 from .base import BaseScheduler, SchedulerRun
+from .eventsim import dependency_aware_order
 
 _INF = float("inf")
 
@@ -64,8 +66,10 @@ class PipelineStageScheduler(BaseScheduler):
 
     name = "pipeline"
 
-    def __init__(self, n_stages: Optional[int] = None):
+    def __init__(self, n_stages: Optional[int] = None,
+                 link: Optional[LinkModel] = None):
         self.n_stages = n_stages
+        self.link = link or LinkModel()
 
     # -- stage planning ----------------------------------------------------
     def plan_stages(
@@ -73,6 +77,7 @@ class PipelineStageScheduler(BaseScheduler):
         graph: TaskGraph,
         devices: List[DeviceState],
         stats: Optional[Tuple[List[str], List[float], List[float], List[Set[str]]]] = None,
+        reserved: Optional[List[float]] = None,
     ) -> Optional[List[int]]:
         """Return stage boundaries (k+1 indices into the group order; stage s
         covers groups [bounds[s], bounds[s+1])) — or None if no feasible
@@ -80,7 +85,8 @@ class PipelineStageScheduler(BaseScheduler):
 
         DP over (groups consumed, stages used) minimizing the bottleneck
         stage compute; memory feasibility is checked against the actual
-        device each stage lands on, so heterogeneous HBM budgets work.
+        device each stage lands on (minus any per-device ``reserved`` GB held
+        by parked groups), so heterogeneous HBM budgets work.
         """
         groups, compute, activ, gparams = stats or _group_stats(graph)
         n = len(groups)
@@ -98,6 +104,8 @@ class PipelineStageScheduler(BaseScheduler):
         best[0][0] = 0.0
         for s in range(1, k + 1):
             cap = devices[s - 1].total_memory
+            if reserved is not None:
+                cap -= reserved[s - 1]  # parked groups' params
             for j in range(s, n + 1):
                 # widen stage [i, j) by stepping i down, growing the param
                 # union / activation max / size sum incrementally; stage
@@ -135,11 +143,97 @@ class PipelineStageScheduler(BaseScheduler):
     # -- policy ------------------------------------------------------------
     def run_policy(self, run: SchedulerRun) -> None:
         graph, devices = run.graph, run.cluster.devices
-        stats = _group_stats(graph)
-        groups, _, activ, gparams = stats
-        bounds = self.plan_stages(graph, devices, stats)
+        all_groups, all_compute, all_activ, all_gparams = _group_stats(graph)
+        n_dev = len(devices)
 
+        # Which groups contain root tasks?  Root-bearing groups (embedding,
+        # or vocab-sharded embedding/logit partials — whose tied weight spans
+        # both ends of the graph, so stage contiguity is impossible for them
+        # anyway) have no upstream locality pull, but their parameters gate
+        # the pipeline start: PARK them — one group per device,
+        # largest-params first onto the least-reserved device — so their
+        # host-link loads run in parallel across the cluster instead of
+        # queueing behind one stage's weights.
+        group_tasks: Dict[str, List[str]] = {}
+        for tid in graph.topo_order:
+            group_tasks.setdefault(graph[tid].group or tid, []).append(tid)
+        is_root_group = {
+            g: any(not graph[t].dependencies for t in tids)
+            for g, tids in group_tasks.items()
+        }
+
+        reserved = [0.0] * n_dev
         stage_of: Dict[str, int] = {}
+
+        def park(gi: int) -> bool:
+            """Park group index `gi` (into all_groups) on the least-reserved
+            device it fits; True on success."""
+            pg = sum(graph.param_size_gb(p) for p in all_gparams[gi])
+            need = pg + all_activ[gi]
+            order = sorted(range(n_dev), key=lambda d: (reserved[d], d))
+            for d in order:
+                if reserved[d] + need <= devices[d].total_memory + 1e-9:
+                    stage_of[all_groups[gi]] = d
+                    reserved[d] += pg
+                    return True
+            return False
+
+        remaining = list(range(len(all_groups)))
+        if len(all_groups) > n_dev:  # tiny graphs: plain contiguous stages
+            parked = [i for i in remaining if is_root_group[all_groups[i]]]
+            for gi in sorted(
+                parked,
+                key=lambda i: -sum(
+                    graph.param_size_gb(p) for p in all_gparams[i]
+                ),
+            ):
+                if park(gi):
+                    remaining.remove(gi)
+
+            # Weight-tied tail (tied embedding/LM-head, reference
+            # test_gpt2.py:160-166): co-locate the last group with the parked
+            # group it shares params with, so the shared table is loaded over
+            # the host link ONCE, early — otherwise the tail stage re-loads
+            # it *behind* its own layer weights, putting the whole table's
+            # load on the pipeline drain.  Standard pipeline-parallel
+            # practice (Megatron/GPipe co-locate embedding + head).
+            if remaining:
+                ti = remaining[-1]
+                parked_params_on: Dict[int, Set[str]] = {}
+                for gi, g in enumerate(all_groups):
+                    if g in stage_of:
+                        parked_params_on.setdefault(
+                            stage_of[g], set()
+                        ).update(all_gparams[gi])
+                tied_dev = next(
+                    (
+                        d for d, ps in sorted(parked_params_on.items())
+                        if all_gparams[ti] & ps
+                    ),
+                    None,
+                )
+                if tied_dev is not None:
+                    extra = sum(
+                        graph.param_size_gb(p)
+                        for p in all_gparams[ti] - parked_params_on[tied_dev]
+                    )
+                    if (
+                        reserved[tied_dev] + extra + all_activ[ti]
+                        <= devices[tied_dev].total_memory + 1e-9
+                    ):
+                        stage_of[all_groups[ti]] = tied_dev
+                        reserved[tied_dev] += extra
+                        remaining.remove(ti)
+
+        stats = (
+            [all_groups[i] for i in remaining],
+            [all_compute[i] for i in remaining],
+            [all_activ[i] for i in remaining],
+            [all_gparams[i] for i in remaining],
+        )
+        bounds = self.plan_stages(graph, devices, stats, reserved)
+        groups, _, activ, gparams = stats
+
         if bounds is not None:
             for s in range(len(bounds) - 1):
                 for i in range(bounds[s], bounds[s + 1]):
@@ -147,13 +241,15 @@ class PipelineStageScheduler(BaseScheduler):
         else:
             # greedy sequential fill: walk groups in order, advancing to the
             # next device when the current one can't also hold this group
+            # (budgets net of parked-group reservations)
             dev = 0
             held: Set[str] = set()
             for i, g in enumerate(groups):
                 while dev < len(devices):
                     need_params = held | gparams[i]
                     need = sum(graph.param_size_gb(p) for p in need_params) + activ[i]
-                    if need <= devices[dev].total_memory + 1e-9:
+                    cap = devices[dev].total_memory - reserved[dev]
+                    if need <= cap + 1e-9:
                         held = need_params
                         break
                     dev, held = dev + 1, set()
@@ -171,3 +267,22 @@ class PipelineStageScheduler(BaseScheduler):
                 self.assign(run, task, node)
             else:
                 self.fail(run, task)
+
+        # Re-order for execution: topo (Kahn-wave) order serializes the
+        # pipeline under in-order per-node replay — every stage would touch
+        # all microbatches' op k before any op k+1, making the fill cost
+        # stages x stage_total.  The event simulation orders each node by
+        # input-arrival time instead, so 1F1B microbatch interleaving
+        # emerges from the DAG structure (see sched/eventsim.py).
+        placement = {
+            tid: run.graph[tid].assigned_node
+            for tid in run.assignment_order
+        }
+        speeds = {d.node_id: d.compute_speed for d in run.cluster}
+        order = dependency_aware_order(
+            run.graph, placement, speeds, self.link
+        )
+        run.assignment_order[:] = order
+        pos = {tid: i for i, tid in enumerate(order)}
+        for nid, tids in run.per_node.items():
+            tids.sort(key=lambda t: pos[t])

@@ -20,6 +20,7 @@ class RunConfig:
     batch: int = 1
     seq_len: int = 512
     microbatches: int = 1
+    vocab_shards: int = 1          # shard the tied embedding/head (gpt2*)
     num_layers: Optional[int] = None  # synthetic workloads / overrides
     train_step: bool = False       # schedule one fwd+bwd+opt step (gpt2*)
 
@@ -117,9 +118,16 @@ class RunConfig:
                 from ..frontend.train_dag import build_gpt2_train_dag
 
                 return build_gpt2_train_dag(cfg, batch=self.batch, seq_len=seq)
+            kw = {}
+            if self.vocab_shards > 1:
+                if not self.model.startswith("gpt2"):
+                    raise ValueError(
+                        "--vocab-shards currently supports gpt2* models only"
+                    )
+                kw["vocab_shards"] = self.vocab_shards
             return builder(
                 cfg, batch=self.batch, seq_len=seq,
-                microbatches=self.microbatches,
+                microbatches=self.microbatches, **kw,
             )
         makers = {
             "llm": lambda: generators.generate_llm_dag(

@@ -1,0 +1,85 @@
+"""Dependency-aware ordering (sched/eventsim.py).
+
+The ordering pass must (a) keep every placed task exactly once, (b) respect
+dependencies among same-node tasks (the replay executes per-node lists in
+order), and (c) actually fix the Kahn-wave head-of-line blocking for a
+microbatched pipeline placement: with stage placement fixed, the reordered
+schedule's replayed makespan must beat wave order by a wide margin.
+"""
+
+from __future__ import annotations
+
+from distributed_llm_scheduler_tpu.backends.sim import LinkModel, SimulatedBackend
+from distributed_llm_scheduler_tpu.core.cluster import Cluster, DeviceState
+from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+from distributed_llm_scheduler_tpu.sched.eventsim import dependency_aware_order
+from distributed_llm_scheduler_tpu.sched.pipeline import PipelineStageScheduler
+
+
+def make_placed_pipeline():
+    # deep-and-narrow: 8 layers over 4 stages, so wave order's serialized
+    # fill (stages x stage_total) clearly dominates proper 1F1B interleaving
+    cfg = GPT2Config(
+        vocab_size=512, n_positions=128, n_embd=128, n_layer=8, n_head=4
+    )
+    dag = build_gpt2_dag(cfg, batch=8, seq_len=16, microbatches=8)
+    # tiny-model seed times are ~0.1us, so ordering couldn't matter; give
+    # every task a compute time that dominates the (tiny) load/transfer
+    # costs, as in the real calibrated graphs
+    for t in dag.graph:
+        t.compute_time = 1e-3
+    cluster = Cluster([DeviceState(f"d{i}", 4.0) for i in range(4)])
+    sched = PipelineStageScheduler().schedule(dag.graph, cluster)
+    assert not sched.failed
+    return dag.graph, cluster, sched
+
+
+def test_order_is_complete_and_dependency_safe():
+    graph, cluster, sched = make_placed_pipeline()
+    placement = sched.placement
+    order = dependency_aware_order(graph, placement)
+    assert sorted(order) == sorted(placement)
+    # same-node tasks must appear after their same-node dependencies
+    pos = {tid: i for i, tid in enumerate(order)}
+    for tid in order:
+        for d in graph[tid].dependencies:
+            if placement[d] == placement[tid]:
+                assert pos[d] < pos[tid], (d, tid)
+
+
+def test_reorder_beats_wave_order():
+    graph, cluster, sched = make_placed_pipeline()
+    link = LinkModel()
+    sim = SimulatedBackend(fidelity="full", link=link)
+    # pipeline policy already emits the reordered schedule
+    reordered = sim.execute(graph, cluster, sched).makespan
+
+    # rebuild the same placement in raw topo (Kahn-wave) order
+    from distributed_llm_scheduler_tpu.core.schedule import Schedule
+
+    placement = sched.placement
+    wave_order = [t for t in graph.topo_order if t in placement]
+    per_node = {d.node_id: [] for d in cluster}
+    for tid in wave_order:
+        per_node[placement[tid]].append(tid)
+    wave = Schedule(
+        policy="pipeline-wave",
+        per_node=per_node,
+        assignment_order=wave_order,
+        completed=set(wave_order),
+        failed=set(),
+    )
+    waved = sim.execute(graph, cluster, wave).makespan
+    assert reordered < waved * 0.75, (reordered, waved)
+
+
+def test_partial_placement_skips_unplaced():
+    graph, cluster, sched = make_placed_pipeline()
+    placement = sched.placement
+    # drop one leaf task: order must simply omit it (failed-task semantics)
+    leaf = [t for t in graph.topo_order if not graph.dependents(t)][-1]
+    placement.pop(leaf)
+    order = dependency_aware_order(graph, placement)
+    assert leaf not in order
+    assert sorted(order) == sorted(placement)

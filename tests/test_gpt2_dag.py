@@ -196,3 +196,40 @@ def test_costmodel_roundtrip(tmp_path, tiny_dag):
         str(tmp_path / f"{tiny_dag.graph.name}_cpu.json")
     )
     assert loaded.task_seconds == cm1.task_seconds
+
+
+def test_vocab_sharded_dag_matches_fused_forward():
+    """Sharded tied embedding/head: partial-lookup sum and logit-slice
+    concat must reproduce the fused forward exactly (each token id hits
+    exactly one shard; slices partition the vocab axis)."""
+    dag = build_gpt2_dag(
+        GPT2Config.tiny(), batch=4, seq_len=16, microbatches=2, vocab_shards=3
+    )
+    graph = dag.graph
+    # per mb: 3 embed partials + combine, 3 logit slices + concat replace
+    # the monolithic embedding/output_projection tasks
+    assert "mb0_embedding_shard_2" in graph
+    assert "mb1_output_projection_shard_0" in graph
+    # the full table is never referenced: every wte use is via shards
+    assert "wte" not in graph.unique_params()
+    params = dag.init_params()
+    ids = dag.make_inputs()
+    fused = dag.reference_forward(params, ids)
+    via_dag = execute_dag_locally(dag, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(via_dag), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_vocab_shard_sizes_cover_vocab():
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=16, vocab_shards=5)
+    rows = [
+        dag.param_specs[f"wte_shard_{k}"].shape[0] for k in range(5)
+    ]
+    assert sum(rows) == dag.config.vocab_size
+    assert all(r > 0 for r in rows)
+
+
+def test_vocab_shards_validation():
+    with pytest.raises(ValueError, match="vocab_shards"):
+        build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=16, vocab_shards=0)
