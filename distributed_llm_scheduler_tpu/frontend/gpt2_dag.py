@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from ..core.graph import Task, TaskGraph
 from ..models import gpt2
 from ..models.gpt2 import GPT2Config
+from .vocab_sharding import logit_concat_fn, make_embed_partial_fn, shard_bounds
 
 # Seed estimate for compute_time: effective sustained FLOP/s of one core on
 # these op sizes.  Deliberately rough — the calibrated cost model
@@ -181,21 +182,14 @@ def build_gpt2_dag(
     B, T, D, H, V = batch, seq_len, config.n_embd, config.n_head, config.vocab_size
     Bm = B // microbatches
     S = vocab_shards
-    if not 1 <= S <= V:
-        raise ValueError(f"vocab_shards {S} out of range [1, {V}]")
     eps = config.ln_eps
 
     specs = {
         name: jax.ShapeDtypeStruct(shape, dtype)
         for name, (shape, dtype) in gpt2.param_shapes(config).items()
     }
+    shard_lo = shard_bounds(V, S)
     if S > 1:
-        # balanced row split: the first V % S shards get one extra row, so
-        # every shard is non-empty for any 1 <= S <= V
-        base, extra = divmod(V, S)
-        shard_lo = [0]
-        for k in range(S):
-            shard_lo.append(shard_lo[-1] + base + (1 if k < extra else 0))
         for k in range(S):
             specs[f"wte_shard_{k}"] = jax.ShapeDtypeStruct(
                 (shard_lo[k + 1] - shard_lo[k], D), specs["wte"].dtype
@@ -213,20 +207,6 @@ def build_gpt2_dag(
             return gpt2.embedding(input_ids[lo:hi], p["wte"], p["wpe"])
 
         return f_embedding
-
-    def make_f_embed_partial(lo, hi, lo_v, rows):
-        """Partial lookup over one vocab-range shard of the table: rows in
-        [lo_v, lo_v+rows) contribute their embedding, others contribute 0 —
-        the shard-sum equals the full lookup exactly (each id hits exactly
-        one shard)."""
-
-        def f_embed_partial(p, input_ids):
-            local = input_ids[lo:hi] - lo_v
-            mask = (local >= 0) & (local < rows)
-            emb = p["shard"][jnp.clip(local, 0, rows - 1)]
-            return emb * mask[..., None].astype(emb.dtype)
-
-        return f_embed_partial
 
     def f_embed_combine(p, *partials):
         T_ = partials[0].shape[-2]
@@ -267,9 +247,6 @@ def build_gpt2_dag(
         never loaded twice (nor anywhere in full)."""
         return x @ p["shard"].T
 
-    def f_logit_concat(p, *slices):
-        return jnp.concatenate(slices, axis=-1)
-
     # ---- graph assembly (8 tasks/layer + 3 per microbatch chain,
     # reference test_gpt2.py:54-166; mb prefix only when pipelining) -------
     hd = D // H
@@ -283,7 +260,7 @@ def build_gpt2_dag(
                 rows = specs[f"wte_shard_{k}"].shape[0]
                 pid = f"{mb}embedding_shard_{k}"
                 add(pid,
-                    make_f_embed_partial(m * Bm, (m + 1) * Bm, shard_lo[k], rows),
+                    make_embed_partial_fn(m * Bm, (m + 1) * Bm, shard_lo[k], rows),
                     [], {"shard": f"wte_shard_{k}"},
                     3.0 * Bm * T * D, f"vocab_shard_{k}")
                 part_ids.append(pid)
@@ -350,7 +327,7 @@ def build_gpt2_dag(
                 add(sid, f_logit_shard, [fln], {"shard": f"wte_shard_{k}"},
                     2.0 * Bm * T * D * rows, f"vocab_shard_{k}")
                 slice_ids.append(sid)
-            add(proj, f_logit_concat, slice_ids, {}, 1.0 * Bm * T * V, "head")
+            add(proj, logit_concat_fn, slice_ids, {}, 1.0 * Bm * T * V, "head")
         else:
             add(proj, f_output_projection, [fln], {"wte": "wte"},
                 2.0 * Bm * T * D * V, "head")

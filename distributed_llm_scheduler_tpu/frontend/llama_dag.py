@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax.numpy as jnp
+
 from ..models import llama
 from ..models.llama import LlamaConfig
 from .backbone import build_decoder_dag
@@ -31,6 +33,7 @@ def build_llama_dag(
     batch: int = 1,
     seq_len: int = 512,
     microbatches: int = 1,
+    vocab_shards: int = 1,
     effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
 ) -> ModelDAG:
     """Build the per-op forward DAG for a Llama config."""
@@ -72,9 +75,13 @@ def build_llama_dag(
 
     name = f"llama_{config.n_layers}l_d{D}_b{batch}_t{T}" + (
         f"_mb{microbatches}" if microbatches > 1 else ""
-    )
+    ) + (f"_vs{vocab_shards}" if vocab_shards > 1 else "") + (
+        "" if config.dtype == jnp.float32
+        else f"_{jnp.dtype(config.dtype).name}"
+    )  # dtype in the name: cost-model caches must not mix dtypes
     return build_decoder_dag(
         config, llama,
         batch=batch, seq_len=seq_len, microbatches=microbatches,
         effective_flops=effective_flops, ffn_section=ffn_section, name=name,
+        vocab_shards=vocab_shards,
     )

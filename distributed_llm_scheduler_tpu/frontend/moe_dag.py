@@ -21,6 +21,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax.numpy as jnp
+
 from ..models import mixtral
 from ..models.mixtral import MixtralConfig
 from .backbone import build_decoder_dag
@@ -32,6 +34,7 @@ def build_moe_dag(
     batch: int = 1,
     seq_len: int = 512,
     microbatches: int = 1,
+    vocab_shards: int = 1,
     effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
 ) -> ModelDAG:
     """Build the per-op forward DAG for a Mixtral config, one task per
@@ -80,9 +83,13 @@ def build_moe_dag(
 
     name = f"mixtral_{config.n_layers}l_d{D}_e{E}_b{batch}_t{T}" + (
         f"_mb{microbatches}" if microbatches > 1 else ""
-    )
+    ) + (f"_vs{vocab_shards}" if vocab_shards > 1 else "") + (
+        "" if config.dtype == jnp.float32
+        else f"_{jnp.dtype(config.dtype).name}"
+    )  # dtype in the name: cost-model caches must not mix dtypes
     return build_decoder_dag(
         config, mixtral,
         batch=batch, seq_len=seq_len, microbatches=microbatches,
         effective_flops=effective_flops, ffn_section=ffn_section, name=name,
+        vocab_shards=vocab_shards,
     )

@@ -1,0 +1,53 @@
+"""Shared vocab-sharding pieces for the model-family DAG builders.
+
+Task-graph tensor parallelism for the vocab-sized tables (embedding,
+LM head): balanced row/column shards, partial-lookup tasks whose sum equals
+the full lookup exactly, and logit-slice concatenation.  GPT-2
+(:mod:`.gpt2_dag`, tied table: row shards serve both ends) and the
+llama-architecture backbone (:mod:`.backbone`, separate ``tok_emb`` /
+``lm_head``) both build their shard tasks from these helpers so the split
+arithmetic and the masked-lookup semantics cannot drift between families.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+import jax.numpy as jnp
+
+
+def shard_bounds(vocab_size: int, shards: int) -> List[int]:
+    """Balanced split boundaries: ``shards + 1`` cumulative offsets where the
+    first ``vocab_size % shards`` shards get one extra row — every shard is
+    non-empty for any ``1 <= shards <= vocab_size``."""
+    if not 1 <= shards <= vocab_size:
+        raise ValueError(
+            f"vocab_shards {shards} out of range [1, {vocab_size}]"
+        )
+    base, extra = divmod(vocab_size, shards)
+    lo = [0]
+    for k in range(shards):
+        lo.append(lo[-1] + base + (1 if k < extra else 0))
+    return lo
+
+
+def make_embed_partial_fn(
+    lo_b: int, hi_b: int, lo_v: int, rows: int
+) -> Callable:
+    """Partial lookup over one row shard (``p["shard"]``): token ids outside
+    ``[lo_v, lo_v + rows)`` contribute 0, so the shard-sum equals the full
+    lookup exactly (each id hits exactly one shard).  ``[lo_b, hi_b)`` slices
+    the microbatch from the full input batch."""
+
+    def f_embed_partial(p, input_ids):
+        local = input_ids[lo_b:hi_b] - lo_v
+        mask = (local >= 0) & (local < rows)
+        emb = p["shard"][jnp.clip(local, 0, rows - 1)]
+        return emb * mask[..., None].astype(emb.dtype)
+
+    return f_embed_partial
+
+
+def logit_concat_fn(p, *slices):
+    """Concatenate per-shard logit slices along the vocab axis."""
+    return jnp.concatenate(slices, axis=-1)

@@ -20,7 +20,7 @@ class RunConfig:
     batch: int = 1
     seq_len: int = 512
     microbatches: int = 1
-    vocab_shards: int = 1          # shard the tied embedding/head (gpt2*)
+    vocab_shards: int = 1          # shard the embedding/LM-head tables
     num_layers: Optional[int] = None  # synthetic workloads / overrides
     train_step: bool = False       # schedule one fwd+bwd+opt step (gpt2*)
 
@@ -100,6 +100,10 @@ class RunConfig:
             raise ValueError(
                 "--train-step does not support --microbatches yet"
             )
+        if self.train_step and self.vocab_shards != 1:
+            raise ValueError(
+                "--train-step does not support --vocab-shards yet"
+            )
 
         family = self._model_family()
         if family is not None:
@@ -118,16 +122,10 @@ class RunConfig:
                 from ..frontend.train_dag import build_gpt2_train_dag
 
                 return build_gpt2_train_dag(cfg, batch=self.batch, seq_len=seq)
-            kw = {}
-            if self.vocab_shards > 1:
-                if not self.model.startswith("gpt2"):
-                    raise ValueError(
-                        "--vocab-shards currently supports gpt2* models only"
-                    )
-                kw["vocab_shards"] = self.vocab_shards
             return builder(
                 cfg, batch=self.batch, seq_len=seq,
-                microbatches=self.microbatches, **kw,
+                microbatches=self.microbatches,
+                vocab_shards=self.vocab_shards,
             )
         makers = {
             "llm": lambda: generators.generate_llm_dag(

@@ -191,3 +191,22 @@ def test_pipeline_graceful_degradation():
     cluster = Cluster([DeviceState("d0", 0.001)])
     s = PipelineStageScheduler().schedule(dag.graph, cluster)
     assert s.failed
+
+
+def test_vocab_sharded_llama_matches_fused(tiny):
+    """Sharded tok_emb (rows) + lm_head (columns): partial-lookup sum and
+    logit-slice concat must reproduce the fused forward exactly."""
+    dag = build_llama_dag(tiny, batch=2, seq_len=16, microbatches=2,
+                          vocab_shards=3)
+    graph = dag.graph
+    assert "mb0_embedding_shard_2" in graph
+    assert "mb1_lm_head_shard_0" in graph
+    assert "tok_emb" not in graph.unique_params()
+    assert "lm_head" not in graph.unique_params()
+    params = dag.init_params()
+    ids = dag.make_inputs()
+    fused = dag.reference_forward(params, ids)
+    via_dag = execute_dag_locally(dag, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(via_dag), rtol=1e-5, atol=1e-5
+    )

@@ -135,3 +135,24 @@ def test_expert_locality_across_microbatches(tiny):
                 homes.setdefault(key, set()).add(node)
     multi = {k: v for k, v in homes.items() if len(v) > 1}
     assert not multi, multi
+
+
+def test_vocab_sharded_mixtral_matches_fused(tiny):
+    """Vocab sharding through the shared decoder backbone works for the MoE
+    family too."""
+    import numpy as np
+
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import (
+        execute_dag_locally,
+    )
+    from distributed_llm_scheduler_tpu.frontend.moe_dag import build_moe_dag
+
+    dag = build_moe_dag(tiny, batch=2, seq_len=16, vocab_shards=2)
+    assert "tok_emb" not in dag.graph.unique_params()
+    params = dag.init_params()
+    ids = dag.make_inputs()
+    fused = dag.reference_forward(params, ids)
+    via_dag = execute_dag_locally(dag, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(via_dag), rtol=1e-5, atol=1e-5
+    )
