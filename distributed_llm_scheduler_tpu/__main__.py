@@ -30,6 +30,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--microbatches", type=int, default=1)
     p.add_argument("--vocab-shards", type=int, default=1, dest="vocab_shards",
                    help="shard the embedding/LM-head tables across tasks")
+    p.add_argument("--fuse", action="store_true",
+                   help="fuse linear task chains before scheduling")
     p.add_argument("--train-step", action="store_true",
                    help="schedule one fwd+bwd+optimizer step (gpt2* models)")
     p.add_argument("--num-layers", type=int, default=None)

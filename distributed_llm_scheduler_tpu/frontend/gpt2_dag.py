@@ -89,6 +89,20 @@ def _bytes_of(spec: Any) -> int:
 _GB = 1024**3
 
 
+def graph_name_tags(microbatches: int, vocab_shards: int, dtype: Any) -> str:
+    """Cache-key-critical name suffix shared by every family builder.
+
+    Graph names key the measured cost-model cache (utils/costmodel), so any
+    build option that changes task structure or timings MUST appear here —
+    one place, or families drift and stale timings get re-applied.
+    """
+    return (
+        (f"_mb{microbatches}" if microbatches > 1 else "")
+        + (f"_vs{vocab_shards}" if vocab_shards > 1 else "")
+        + ("" if dtype == jnp.float32 else f"_{jnp.dtype(dtype).name}")
+    )
+
+
 def make_task_adder(
     tasks: List["Task"],
     out_specs: Dict[str, Any],
@@ -336,15 +350,8 @@ def build_gpt2_dag(
     if microbatches > 1:
         add("output_concat", f_concat, mb_outputs, {}, 1.0 * B * T * V, "head")
 
-    # name encodes width/dtype/sharding too: cost-model caches key on graph
-    # name, and two configs with equal layer/batch/seq but different widths,
-    # dtypes, or shard layouts must not share measured timings
-    dtag = "" if config.dtype == jnp.float32 else f"_{jnp.dtype(config.dtype).name}"
-    name = (
-        f"gpt2_{config.n_layer}l_d{D}_b{B}_t{T}"
-        + (f"_mb{microbatches}" if microbatches > 1 else "")
-        + (f"_vs{S}" if S > 1 else "")
-        + dtag
+    name = f"gpt2_{config.n_layer}l_d{D}_b{B}_t{T}" + graph_name_tags(
+        microbatches, S, config.dtype
     )
 
     def init_fn(key):

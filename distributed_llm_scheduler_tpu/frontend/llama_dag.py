@@ -20,12 +20,11 @@ from __future__ import annotations
 
 from typing import Optional
 
-import jax.numpy as jnp
 
 from ..models import llama
 from ..models.llama import LlamaConfig
 from .backbone import build_decoder_dag
-from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG
+from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, graph_name_tags
 
 
 def build_llama_dag(
@@ -73,12 +72,9 @@ def build_llama_dag(
             2.0 * Bm * T * F * D, grp)
         return down
 
-    name = f"llama_{config.n_layers}l_d{D}_b{batch}_t{T}" + (
-        f"_mb{microbatches}" if microbatches > 1 else ""
-    ) + (f"_vs{vocab_shards}" if vocab_shards > 1 else "") + (
-        "" if config.dtype == jnp.float32
-        else f"_{jnp.dtype(config.dtype).name}"
-    )  # dtype in the name: cost-model caches must not mix dtypes
+    name = f"llama_{config.n_layers}l_d{D}_b{batch}_t{T}" + graph_name_tags(
+        microbatches, vocab_shards, config.dtype
+    )
     return build_decoder_dag(
         config, llama,
         batch=batch, seq_len=seq_len, microbatches=microbatches,

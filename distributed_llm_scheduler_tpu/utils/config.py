@@ -21,6 +21,7 @@ class RunConfig:
     seq_len: int = 512
     microbatches: int = 1
     vocab_shards: int = 1          # shard the embedding/LM-head tables
+    fuse: bool = False             # fuse linear task chains (core/fusion.py)
     num_layers: Optional[int] = None  # synthetic workloads / overrides
     train_step: bool = False       # schedule one fwd+bwd+opt step (gpt2*)
 
@@ -104,6 +105,8 @@ class RunConfig:
             raise ValueError(
                 "--train-step does not support --vocab-shards yet"
             )
+        if self.train_step and self.fuse:
+            raise ValueError("--train-step does not support --fuse yet")
 
         family = self._model_family()
         if family is not None:
@@ -122,11 +125,18 @@ class RunConfig:
                 from ..frontend.train_dag import build_gpt2_train_dag
 
                 return build_gpt2_train_dag(cfg, batch=self.batch, seq_len=seq)
-            return builder(
+            dag = builder(
                 cfg, batch=self.batch, seq_len=seq,
                 microbatches=self.microbatches,
                 vocab_shards=self.vocab_shards,
             )
+            if self.fuse:
+                from ..core.fusion import fuse_linear_chains
+
+                dag = dataclasses.replace(
+                    dag, graph=fuse_linear_chains(dag.graph)
+                )
+            return dag
         makers = {
             "llm": lambda: generators.generate_llm_dag(
                 num_layers=self.num_layers or 4, seed=self.seed
@@ -144,7 +154,12 @@ class RunConfig:
                 "llama[-8b|-tiny] / mixtral[-8x7b|-tiny] / llm / random / "
                 "pipeline"
             )
-        return makers[self.model]()
+        graph = makers[self.model]()
+        if self.fuse:
+            from ..core.fusion import fuse_linear_chains
+
+            graph = fuse_linear_chains(graph)
+        return graph
 
     def build_cluster(self):
         from ..core.cluster import Cluster
