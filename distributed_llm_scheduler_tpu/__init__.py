@@ -20,13 +20,16 @@ See SURVEY.md for the layer map and parity notes.
 import os as _os
 
 # DLS_PLATFORM=cpu|tpu pins the JAX platform before the first backend touch
-# (e.g. to keep CLI/dev runs on the host when no accelerator is reachable).
-# Must run before anything resolves a backend; importing this package first
-# is enough.
-if _os.environ.get("DLS_PLATFORM"):
+# (e.g. to keep CLI/dev runs on the host when no accelerator is reachable);
+# DLS_FORCE_CPU=1 is shorthand for DLS_PLATFORM=cpu.  Must run before
+# anything resolves a backend; importing this package first is enough.
+_plat = _os.environ.get("DLS_PLATFORM") or (
+    "cpu" if _os.environ.get("DLS_FORCE_CPU") else None
+)
+if _plat:
     import jax as _jax
 
-    _jax.config.update("jax_platforms", _os.environ["DLS_PLATFORM"])
+    _jax.config.update("jax_platforms", _plat)
 
 from .core.graph import (
     DEFAULT_PARAM_GB,
@@ -36,7 +39,9 @@ from .core.graph import (
     TaskStatus,
 )
 from .core.cluster import Cluster, DeviceState, estimate_cluster_memory_needed
+from .core.fusion import fuse_linear_chains
 from .core.schedule import Schedule, TaskTiming
+from .core.validate import ValidationReport, validate_schedule
 from .sched.base import BaseScheduler
 from .sched.policies import (
     ALL_SCHEDULERS,
@@ -61,6 +66,9 @@ __all__ = [
     "estimate_cluster_memory_needed",
     "Schedule",
     "TaskTiming",
+    "fuse_linear_chains",
+    "ValidationReport",
+    "validate_schedule",
     "BaseScheduler",
     "ALL_SCHEDULERS",
     "RoundRobinScheduler",

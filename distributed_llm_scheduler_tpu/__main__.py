@@ -73,6 +73,13 @@ def cmd_schedule(args) -> int:
     cluster = cfg.build_cluster()
     sched = get_scheduler(cfg.scheduler)
     schedule = sched.schedule(graph, cluster)
+    if args.validate:
+        from .core.validate import validate_schedule
+
+        vrep = validate_schedule(graph, cluster, schedule)
+        print(f"validator: {vrep.summary()}", file=sys.stderr)
+        if not vrep.ok:
+            return 2
     rep = _replay_backend(cfg).execute(
         graph, cluster, schedule, dag_type=cfg.model
     )
@@ -191,15 +198,8 @@ def cmd_bench(args) -> int:
 
 
 def main(argv=None) -> int:
-    import os
-
-    if os.environ.get("DLS_FORCE_CPU"):
-        # must happen before any backend init; the site-installed TPU plugin
-        # otherwise claims the backend even when JAX_PLATFORMS=cpu is set
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
+    # DLS_PLATFORM / DLS_FORCE_CPU are applied by the package __init__,
+    # which python -m imports before this function runs.
     ap = argparse.ArgumentParser(
         prog="distributed_llm_scheduler_tpu",
         description="TPU-native memory-constrained DAG scheduling for LLMs",
@@ -209,6 +209,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("schedule", help="place a DAG and report metrics")
     _add_common(p)
     p.add_argument("--save", action="store_true", help="save graph+schedule JSON")
+    p.add_argument("--validate", action="store_true",
+                   help="run the independent schedule checker (exit 2 on violations)")
     p.set_defaults(fn=cmd_schedule)
 
     p = sub.add_parser("sweep", help="full evaluation sweep (CSV+PNG)")

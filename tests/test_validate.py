@@ -1,0 +1,132 @@
+"""Independent schedule validator (core/validate.py).
+
+Every policy's output must validate cleanly; deliberately corrupted
+schedules must be caught — this checker shares no code with the policies,
+which is the point (SURVEY.md §5.2: scheduler-correctness validation as the
+TPU analog of race detection).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from distributed_llm_scheduler_tpu.core.cluster import Cluster
+from distributed_llm_scheduler_tpu.core.validate import validate_schedule
+from distributed_llm_scheduler_tpu.frontend.generators import generate_llm_dag
+from distributed_llm_scheduler_tpu.sched.policies import ALL_SCHEDULERS, get_scheduler
+
+
+def make():
+    graph = generate_llm_dag(num_layers=6, num_heads=4, seed=9)
+    cluster = Cluster.heterogeneous(20.0, 4)
+    return graph, cluster
+
+
+def test_all_policies_validate():
+    graph, _ = make()
+    for name in ALL_SCHEDULERS:
+        cluster = Cluster.heterogeneous(20.0, 4)
+        s = get_scheduler(name).schedule(graph, cluster)
+        rep = validate_schedule(graph, cluster, s)
+        assert rep.ok, (name, rep.summary())
+
+
+def test_native_policies_validate():
+    import pytest
+
+    from distributed_llm_scheduler_tpu.native import available
+
+    if not available():
+        pytest.skip("native engine unavailable")
+    graph, _ = make()
+    for name in ("native:mru", "native:heft", "native:roundrobin"):
+        cluster = Cluster.heterogeneous(20.0, 4)
+        s = get_scheduler(name).schedule(graph, cluster)
+        rep = validate_schedule(graph, cluster, s)
+        assert rep.ok, (name, rep.summary())
+
+
+def test_mru_eviction_reported_not_flagged():
+    """MRU on a tight cluster relies on eviction: valid, but diagnosed."""
+    graph, _ = make()
+    cluster = Cluster.uniform(2, 4.0)
+    s = get_scheduler("mru").schedule(graph, cluster)
+    assert not s.failed
+    rep = validate_schedule(graph, cluster, s)
+    assert rep.ok
+    assert rep.requires_eviction  # no-evict residency exceeds 4 GB
+    strict = validate_schedule(graph, cluster, s, strict=True)
+    assert not strict.ok
+
+
+def test_catches_dependency_order_violation():
+    graph, cluster = make()
+    s = get_scheduler("greedy").schedule(graph, cluster)
+    bad = copy.deepcopy(s)
+    # move the last task to the front of the global order and its node list
+    tid = bad.assignment_order[-1]
+    bad.assignment_order.remove(tid)
+    bad.assignment_order.insert(0, tid)
+    for tids in bad.per_node.values():
+        if tid in tids:
+            tids.remove(tid)
+            tids.insert(0, tid)
+    rep = validate_schedule(graph, cluster, bad)
+    assert not rep.ok
+    assert any("ordered before" in x for x in rep.violations)
+
+
+def test_catches_double_placement_and_missing_task():
+    graph, cluster = make()
+    s = get_scheduler("greedy").schedule(graph, cluster)
+    bad = copy.deepcopy(s)
+    nodes = [n for n, t in bad.per_node.items() if t]
+    stolen = bad.per_node[nodes[0]][0]
+    bad.per_node[nodes[-1]].append(stolen)  # now placed twice
+    rep = validate_schedule(graph, cluster, bad)
+    assert any("placed on both" in x for x in rep.violations)
+
+    bad2 = copy.deepcopy(s)
+    victim = bad2.assignment_order[len(bad2.assignment_order) // 2]
+    for tids in bad2.per_node.values():
+        if victim in tids:
+            tids.remove(victim)
+    rep2 = validate_schedule(graph, cluster, bad2)
+    assert not rep2.ok  # order no longer a permutation of placements
+
+
+def test_catches_oversized_task():
+    graph, _ = make()
+    cluster = Cluster.uniform(2, 0.5)
+    s = get_scheduler("roundrobin").schedule(graph, cluster)
+    # force-place a failed oversized task to simulate a broken scheduler
+    bad = copy.deepcopy(s)
+    oversized = sorted(bad.failed)[0]
+    bad.failed.discard(oversized)
+    bad.completed.add(oversized)
+    bad.per_node[cluster.ids()[0]].append(oversized)
+    bad.assignment_order.append(oversized)
+    rep = validate_schedule(graph, cluster, bad)
+    assert not rep.ok
+
+
+def test_catches_dropped_tasks_and_empty_schedule():
+    """Reviewer repro: silently dropped sinks / empty schedules must fail."""
+    from distributed_llm_scheduler_tpu.core.schedule import Schedule
+
+    graph, cluster = make()
+    s = get_scheduler("greedy").schedule(graph, cluster)
+    bad = copy.deepcopy(s)
+    sink = bad.assignment_order[-1]
+    bad.assignment_order.remove(sink)
+    bad.completed.discard(sink)
+    for tids in bad.per_node.values():
+        if sink in tids:
+            tids.remove(sink)
+    rep = validate_schedule(graph, cluster, bad)
+    assert not rep.ok
+    assert any("neither completed nor failed" in x for x in rep.violations)
+
+    empty = Schedule(policy="nothing")
+    rep2 = validate_schedule(graph, cluster, empty)
+    assert not rep2.ok
