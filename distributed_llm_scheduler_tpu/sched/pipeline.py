@@ -123,6 +123,10 @@ class PipelineStageScheduler(BaseScheduler):
                         break
                     if best[i][s - 1] == _INF:
                         continue
+                    # bottleneck metric is stage COMPUTE only: weights load
+                    # once and overlap the pipeline (measured: folding load
+                    # time into the stage cost over-weights it and degrades
+                    # the replayed makespan)
                     cand = max(best[i][s - 1], prefix[j] - prefix[i])
                     if cand < best[j][s]:
                         best[j][s] = cand
