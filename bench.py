@@ -54,6 +54,8 @@ def main() -> None:
         # The axon TPU tunnel can hang jax.devices() indefinitely (observed
         # mid-round).  Probe backend init in a SUBPROCESS (clean state, same
         # sitecustomize) and fall back to CPU so the bench always completes.
+        # Trade-off, accepted: a healthy run pays one extra backend init
+        # (~10-20 s, once per round) for guaranteed hang protection.
         import subprocess
 
         try:

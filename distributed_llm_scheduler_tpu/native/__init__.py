@@ -21,7 +21,7 @@ from typing import Optional
 _HERE = Path(__file__).resolve().parent
 _SOURCE = _HERE / "engine.cpp"
 _BUILD_DIR = _HERE / "_build"
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lock = threading.Lock()
 _engine: Optional[ctypes.CDLL] = None
@@ -34,6 +34,7 @@ POLICY_IDS = {
     "critical": 3,
     "mru": 4,
     "heft": 5,
+    "pipeline": 6,
 }
 
 
@@ -73,6 +74,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i32p, i32p,            # par_off, par_ids
         f64p, f64p, f64p,      # param_gb, node_mem, node_speed
         f64p,                  # link3
+        i32p,                  # group_ids (pipeline only; NULL otherwise)
         i32p, i32p, i32p,      # out_assign, out_order, out_n_assigned
     ]
     lib.dls_abi_version.restype = ctypes.c_int

@@ -22,6 +22,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <queue>
 #include <vector>
 
 namespace {
@@ -525,19 +526,352 @@ void run_heft(Run& run, const double* link) {
       [&](int a, int b) { return start_at[a] < start_at[b]; });
 }
 
+// ---------------------------------------------------------------------------
+// Pipeline stage policy (sched/pipeline.py) + dependency-aware event-ordered
+// dispatch (sched/eventsim.py).  group_ids: per-task group index assigned by
+// first appearance in topo order on the Python side (singleton groups for
+// ungrouped tasks), so group index order == the Python group order.
+// ---------------------------------------------------------------------------
+
+struct EventOrder {
+  std::vector<int32_t> order;     // task ids by simulated start
+};
+
+// dependency_aware_order: deepest-arrived-first per node (1F1B), else
+// earliest arrival; parameter prefetch queues per node in first-use order.
+EventOrder event_order(const Graph& g, const Run& run,
+                       const std::vector<int32_t>& topo,
+                       const double* link3) {
+  const double load_gbps = link3[0], ici_gbps = link3[1], lat = link3[2];
+  auto param_load_time = [&](double gb) {
+    return load_gbps <= 0 ? 0.0 : lat + gb / load_gbps;
+  };
+  auto transfer_time = [&](double gb) {
+    return ici_gbps <= 0 ? 0.0 : lat + gb / ici_gbps;
+  };
+
+  std::vector<int32_t> topo_pos(g.n_tasks, 0);
+  for (size_t i = 0; i < topo.size(); ++i) topo_pos[topo[i]] = (int32_t)i;
+  // depth from roots (TaskGraph.depths)
+  std::vector<int32_t> depth(g.n_tasks, 0);
+  for (int tid : topo) {
+    int d = 0;
+    for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k)
+      d = std::max(d, depth[g.dep_ids[k]] + 1);
+    depth[tid] = g.ndeps(tid) ? d : 0;
+  }
+
+  struct ReadyItem { int32_t tid; double arrival; };
+  std::vector<std::vector<ReadyItem>> ready(g.n_nodes);
+  std::vector<double> node_free(g.n_nodes, 0.0);
+  std::vector<double> load_queue_end(g.n_nodes, 0.0);
+  std::vector<uint8_t> cached((size_t)g.n_nodes * g.n_params, 0);
+  std::vector<int32_t> missing(g.n_tasks, -1);
+  std::vector<double> arrival(g.n_tasks, 0.0), finish(g.n_tasks, 0.0);
+  std::vector<double> start_at(g.n_tasks, 0.0);
+
+  for (int tid : topo) {
+    if (run.assign[tid] < 0) continue;
+    int m = 0;
+    for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k)
+      if (run.assign[g.dep_ids[k]] >= 0) ++m;
+    missing[tid] = m;
+    if (m == 0) ready[run.assign[tid]].push_back({tid, 0.0});
+  }
+
+  // completion events: min-heap on (finish, topo_pos)
+  using Ev = std::pair<double, int32_t>;  // (finish, topo_pos); tid via topo
+  std::priority_queue<Ev, std::vector<Ev>, std::greater<Ev>> events;
+  constexpr double EPS = 1e-12;
+
+  auto dispatch = [&](int nid) {
+    auto& lst = ready[nid];
+    if (lst.empty()) return;
+    double now = node_free[nid];
+    // deepest among arrived (ties: max (depth, -topo_pos) like the Python
+    // max over (depth, -topo_pos, i) tuples), else earliest arrival with
+    // topo tie-break
+    int best = -1;
+    for (size_t i = 0; i < lst.size(); ++i) {
+      if (lst[i].arrival <= now + EPS) {
+        if (best < 0 ||
+            depth[lst[i].tid] > depth[lst[best].tid] ||
+            (depth[lst[i].tid] == depth[lst[best].tid] &&
+             topo_pos[lst[i].tid] < topo_pos[lst[best].tid]))
+          best = (int)i;
+      }
+    }
+    if (best < 0) {
+      for (size_t i = 0; i < lst.size(); ++i) {
+        if (best < 0 || lst[i].arrival < lst[best].arrival ||
+            (lst[i].arrival == lst[best].arrival &&
+             topo_pos[lst[i].tid] < topo_pos[lst[best].tid]))
+          best = (int)i;
+      }
+    }
+    int tid = lst[best].tid;
+    double dep_ready = lst[best].arrival;
+    lst.erase(lst.begin() + best);
+    double params_ready = 0.0;
+    for (int k = g.par_off[tid]; k < g.par_off[tid + 1]; ++k) {
+      int p = g.par_ids[k];
+      if (!cached[(size_t)nid * g.n_params + p]) {
+        cached[(size_t)nid * g.n_params + p] = 1;
+        load_queue_end[nid] += param_load_time(g.param_gb[p]);
+        params_ready = std::max(params_ready, load_queue_end[nid]);
+      }
+    }
+    double start = std::max(now, std::max(dep_ready, params_ready));
+    double dur = g.task_time[tid] / g.node_speed[nid];
+    start_at[tid] = start;
+    finish[tid] = start + dur;
+    node_free[nid] = start + dur;
+    events.push({start + dur, topo_pos[tid]});
+  };
+
+  for (int n = 0; n < g.n_nodes; ++n) dispatch(n);
+
+  std::vector<int32_t> by_pos(g.n_tasks, -1);
+  for (int t = 0; t < g.n_tasks; ++t) by_pos[topo_pos[t]] = t;
+  while (!events.empty()) {
+    auto ev = events.top();
+    events.pop();
+    int tid = by_pos[ev.second];
+    int nid = run.assign[tid];
+    for (int k = g.dpt_off[tid]; k < g.dpt_off[tid + 1]; ++k) {
+      int dep = g.dpt_ids[k];
+      if (run.assign[dep] < 0 || missing[dep] < 0) continue;
+      int dep_nid = run.assign[dep];
+      double arr = finish[tid];
+      if (dep_nid != nid) arr += transfer_time(g.task_mem[tid]);
+      arrival[dep] = std::max(arrival[dep], arr);
+      if (--missing[dep] == 0) {
+        ready[dep_nid].push_back({dep, arrival[dep]});
+        if (node_free[dep_nid] <= arrival[dep]) dispatch(dep_nid);
+      }
+    }
+    dispatch(nid);
+  }
+  for (int n = 0; n < g.n_nodes; ++n)
+    while (!ready[n].empty()) dispatch(n);
+
+  EventOrder out;
+  for (int tid : topo)
+    if (run.assign[tid] >= 0) out.order.push_back(tid);
+  std::stable_sort(out.order.begin(), out.order.end(), [&](int a, int b) {
+    return start_at[a] < start_at[b] ||
+           (start_at[a] == start_at[b] && topo_pos[a] < topo_pos[b]);
+  });
+  return out;
+}
+
+void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
+  const Graph& g = run.g;
+  int n_dev = g.n_nodes;
+  std::vector<int32_t> topo = g.toposort();
+
+  // group stats in first-appearance (== group id) order
+  int n_groups = 0;
+  for (int t = 0; t < g.n_tasks; ++t)
+    n_groups = std::max(n_groups, group_ids[t] + 1);
+  std::vector<double> compute(n_groups, 0.0), activ(n_groups, 0.0);
+  std::vector<std::vector<int32_t>> gparams(n_groups);  // sorted, unique
+  std::vector<uint8_t> seen(g.n_params, 0);
+  std::vector<uint8_t> has_root(n_groups, 0);
+  for (int t = 0; t < g.n_tasks; ++t) {  // insertion order, like Python
+    int gi = group_ids[t];
+    compute[gi] += g.task_time[t];
+    activ[gi] = std::max(activ[gi], g.task_mem[t]);
+    if (g.ndeps(t) == 0) has_root[gi] = 1;
+  }
+  for (int t = 0; t < g.n_tasks; ++t)  // one pass, not per-group rescans
+    for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
+      gparams[group_ids[t]].push_back(g.par_ids[k]);
+  std::vector<double> pg_of(n_groups, 0.0);
+  for (int gi = 0; gi < n_groups; ++gi) {
+    std::vector<int32_t>& ps = gparams[gi];
+    std::sort(ps.begin(), ps.end());
+    ps.erase(std::unique(ps.begin(), ps.end()), ps.end());
+    for (int p : ps) pg_of[gi] += g.param_gb[p];  // ascending == name order
+  }
+
+  std::vector<double> reserved(n_dev, 0.0);
+  std::vector<int32_t> stage_of_group(n_groups, -1);
+  std::vector<int32_t> remaining;
+  for (int gi = 0; gi < n_groups; ++gi) remaining.push_back(gi);
+
+  if (n_groups > n_dev) {
+    // park root-bearing groups, largest params first (stable ties)
+    std::vector<int32_t> parked;
+    for (int gi : remaining)
+      if (has_root[gi]) parked.push_back(gi);
+    std::stable_sort(parked.begin(), parked.end(), [&](int a, int b) {
+      return pg_of[a] > pg_of[b];
+    });
+    for (int gi : parked) {
+      double pg = pg_of[gi];
+      double need = pg + activ[gi];
+      // least-reserved device, ties by index
+      std::vector<int32_t> devs(n_dev);
+      for (int d = 0; d < n_dev; ++d) devs[d] = d;
+      std::stable_sort(devs.begin(), devs.end(), [&](int a, int b) {
+        return reserved[a] < reserved[b];
+      });
+      for (int d : devs) {
+        if (reserved[d] + need <= g.node_mem[d] + 1e-9) {
+          stage_of_group[gi] = d;
+          reserved[d] += pg;
+          remaining.erase(
+              std::find(remaining.begin(), remaining.end(), gi));
+          break;
+        }
+      }
+    }
+    // weight-tied tail onto the parked device sharing its params
+    if (!remaining.empty()) {
+      int ti = remaining.back();
+      std::vector<std::vector<uint8_t>> parked_on(
+          n_dev, std::vector<uint8_t>(g.n_params, 0));
+      for (int gi = 0; gi < n_groups; ++gi)
+        if (stage_of_group[gi] >= 0)
+          for (int p : gparams[gi]) parked_on[stage_of_group[gi]][p] = 1;
+      int tied_dev = -1;
+      for (int d = 0; d < n_dev && tied_dev < 0; ++d)
+        for (int p : gparams[ti])
+          if (parked_on[d][p]) {
+            tied_dev = d;
+            break;
+          }
+      if (tied_dev >= 0) {
+        double extra = 0.0;
+        for (int p : gparams[ti])  // ascending == sorted(name) order
+          if (!parked_on[tied_dev][p]) extra += g.param_gb[p];
+        if (reserved[tied_dev] + extra + activ[ti] <=
+            g.node_mem[tied_dev] + 1e-9) {
+          stage_of_group[ti] = tied_dev;
+          reserved[tied_dev] += extra;
+          remaining.pop_back();
+        }
+      }
+    }
+  }
+
+  // contiguous-stage DP over remaining groups (plan_stages)
+  int n = (int)remaining.size();
+  if (n > 0) {
+    int kmax = std::min(n, n_dev);
+    std::vector<double> prefix(n + 1, 0.0);
+    for (int i = 0; i < n; ++i)
+      prefix[i + 1] = prefix[i] + compute[remaining[i]];
+    const double INF = 1e300;
+    std::vector<std::vector<double>> best(
+        n + 1, std::vector<double>(kmax + 1, INF));
+    std::vector<std::vector<int32_t>> choice(
+        n + 1, std::vector<int32_t>(kmax + 1, -1));
+    best[0][0] = 0.0;
+    std::vector<uint8_t> inparams(g.n_params, 0);
+    for (int s = 1; s <= kmax; ++s) {
+      double cap = g.node_mem[s - 1] - reserved[s - 1];
+      for (int j = s; j <= n; ++j) {
+        std::fill(inparams.begin(), inparams.end(), 0);
+        double pg = 0.0, act = 0.0;
+        for (int i = j - 1; i >= s - 1; --i) {
+          for (int p : gparams[remaining[i]])
+            if (!inparams[p]) {
+              inparams[p] = 1;
+              pg += g.param_gb[p];
+            }
+          act = std::max(act, activ[remaining[i]]);
+          if (pg + act > cap + 1e-9) break;
+          if (best[i][s - 1] >= INF) continue;
+          double cand = std::max(best[i][s - 1], prefix[j] - prefix[i]);
+          if (cand < best[j][s]) {
+            best[j][s] = cand;
+            choice[j][s] = i;
+          }
+        }
+      }
+    }
+    int s_best = -1;
+    for (int s = 1; s <= kmax; ++s)
+      if (best[n][s] < INF && (s_best < 0 || best[n][s] < best[n][s_best]))
+        s_best = s;
+    if (s_best > 0) {
+      std::vector<int32_t> bounds(s_best + 1, 0);
+      bounds[s_best] = n;
+      int j = n;
+      for (int t = s_best; t > 0; --t) {
+        j = choice[j][t];
+        bounds[t - 1] = j;
+      }
+      for (int s = 0; s < s_best; ++s)
+        for (int i = bounds[s]; i < bounds[s + 1]; ++i)
+          stage_of_group[remaining[i]] = s;
+    } else {
+      // greedy sequential fill with reserved-aware budgets
+      int dev = 0;
+      std::vector<uint8_t> held(g.n_params, 0);
+      for (int idx = 0; idx < n; ++idx) {
+        int gi = remaining[idx];
+        while (dev < n_dev) {
+          // union held | group params, summed in ascending (name) order
+          double need = 0.0;
+          std::vector<uint8_t> u = held;
+          for (int p : gparams[gi]) u[p] = 1;
+          for (int p = 0; p < g.n_params; ++p)
+            if (u[p]) need += g.param_gb[p];
+          double cap = g.node_mem[dev] - reserved[dev];
+          if (need + activ[gi] <= cap + 1e-9) {
+            held = u;
+            break;
+          }
+          ++dev;
+          std::fill(held.begin(), held.end(), 0);
+        }
+        stage_of_group[gi] = std::min(dev, n_dev - 1);
+      }
+    }
+  }
+
+  // assign in topo order; fail tasks whose deps failed or that don't fit
+  for (int tid : topo) {
+    if (!run.pending[tid]) continue;
+    bool dep_failed = false;
+    for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k)
+      if (run.failed[g.dep_ids[k]]) dep_failed = true;
+    if (dep_failed) {
+      run.do_fail(tid);
+      continue;
+    }
+    int node = stage_of_group[group_ids[tid]];
+    if (node >= 0 && run.can_fit(tid, node)) {
+      run.do_assign(tid, node);
+    } else {
+      run.do_fail(tid);
+    }
+  }
+
+  // re-order for execution (sched/eventsim.py semantics)
+  EventOrder eo = event_order(g, run, topo, link3);
+  run.order = std::move(eo.order);
+}
+
 }  // namespace
 
 extern "C" {
 
-// Returns 0 on success; -1 on bad policy id.  out_assign[t] = node index or
-// -1 (failed); out_order = task indices in final global assignment order,
-// length = return count via *out_n_assigned.
+// Returns 0 on success; -1 on bad policy id; -2 if policy 6 (pipeline) is
+// called without group_ids.  out_assign[t] = node index or -1 (failed);
+// out_order = task indices in final global assignment order, length via
+// *out_n_assigned.  group_ids: per-task group index (first-appearance order
+// over the topo sort), required for the pipeline policy, NULL otherwise.
 int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
                  const double* task_mem, const double* task_time,
                  const int32_t* dep_off, const int32_t* dep_ids,
                  const int32_t* par_off, const int32_t* par_ids,
                  const double* param_gb, const double* node_mem,
                  const double* node_speed, const double* link3,
+                 const int32_t* group_ids,
                  int32_t* out_assign, int32_t* out_order,
                  int32_t* out_n_assigned) {
   Graph g;
@@ -563,6 +897,10 @@ int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
     case 3: run_critical(run); break;
     case 4: run_mru(run); break;
     case 5: run_heft(run, link3); break;
+    case 6:
+      if (group_ids == nullptr) return -2;
+      run_pipeline(run, link3, group_ids);
+      break;
     default: return -1;
   }
   std::memcpy(out_assign, run.assign.data(), sizeof(int32_t) * n_tasks);
@@ -572,6 +910,6 @@ int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
   return 0;
 }
 
-int dls_abi_version() { return 1; }
+int dls_abi_version() { return 2; }
 
 }  // extern "C"

@@ -85,7 +85,8 @@ class BaseScheduler:
         and (eviction) credits can never disagree.
         """
         need = task.memory_required
-        for p in task.params_needed:
+        # name order: deterministic float accumulation (native-engine parity)
+        for p in sorted(task.params_needed):
             if p not in node.cached_params:
                 need += run.graph.param_size_gb(p)
         return need

@@ -92,6 +92,19 @@ class NativeScheduler(BaseScheduler):
         )
         link3 = np.asarray(self._link, dtype=np.float64)
 
+        group_ids = None
+        if self.policy == "pipeline":
+            # group index by first appearance over the TOPO order, matching
+            # the Python _group_stats ordering (ungrouped: singleton groups)
+            gidx: Dict[str, int] = {}
+            for t in graph.topo_order:
+                glabel = graph[t].group or t
+                if glabel not in gidx:
+                    gidx[glabel] = len(gidx)
+            group_ids = np.asarray(
+                [gidx[graph[t].group or t] for t in tids], dtype=np.int32
+            )
+
         out_assign = np.empty(n, dtype=np.int32)
         out_order = np.empty(max(n, 1), dtype=np.int32)
         out_n = np.zeros(1, dtype=np.int32)
@@ -108,6 +121,7 @@ class NativeScheduler(BaseScheduler):
             ptr(par_off, ctypes.c_int32), ptr(par_arr, ctypes.c_int32),
             ptr(param_gb, ctypes.c_double), ptr(node_mem, ctypes.c_double),
             ptr(node_speed, ctypes.c_double), ptr(link3, ctypes.c_double),
+            None if group_ids is None else ptr(group_ids, ctypes.c_int32),
             ptr(out_assign, ctypes.c_int32), ptr(out_order, ctypes.c_int32),
             ptr(out_n, ctypes.c_int32),
         )

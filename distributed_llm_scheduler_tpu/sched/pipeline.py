@@ -114,7 +114,8 @@ class PipelineStageScheduler(BaseScheduler):
                 pg = 0.0
                 act = 0.0
                 for i in range(j - 1, s - 2, -1):
-                    for p in gparams[i]:
+                    # sorted: deterministic float accumulation (native parity)
+                    for p in sorted(gparams[i]):
                         if p not in params:
                             params.add(p)
                             pg += graph.param_size_gb(p)
@@ -172,7 +173,7 @@ class PipelineStageScheduler(BaseScheduler):
         def park(gi: int) -> bool:
             """Park group index `gi` (into all_groups) on the least-reserved
             device it fits; True on success."""
-            pg = sum(graph.param_size_gb(p) for p in all_gparams[gi])
+            pg = sum(graph.param_size_gb(p) for p in sorted(all_gparams[gi]))
             need = pg + all_activ[gi]
             order = sorted(range(n_dev), key=lambda d: (reserved[d], d))
             for d in order:
@@ -188,7 +189,7 @@ class PipelineStageScheduler(BaseScheduler):
             for gi in sorted(
                 parked,
                 key=lambda i: -sum(
-                    graph.param_size_gb(p) for p in all_gparams[i]
+                    graph.param_size_gb(p) for p in sorted(all_gparams[i])
                 ),
             ):
                 if park(gi):
@@ -219,7 +220,7 @@ class PipelineStageScheduler(BaseScheduler):
                 if tied_dev is not None:
                     extra = sum(
                         graph.param_size_gb(p)
-                        for p in all_gparams[ti] - parked_params_on[tied_dev]
+                        for p in sorted(all_gparams[ti] - parked_params_on[tied_dev])
                     )
                     if (
                         reserved[tied_dev] + extra + all_activ[ti]
@@ -251,7 +252,9 @@ class PipelineStageScheduler(BaseScheduler):
             for i, g in enumerate(groups):
                 while dev < len(devices):
                     need_params = held | gparams[i]
-                    need = sum(graph.param_size_gb(p) for p in need_params) + activ[i]
+                    need = sum(
+                        graph.param_size_gb(p) for p in sorted(need_params)
+                    ) + activ[i]
                     cap = devices[dev].total_memory - reserved[dev]
                     if need <= cap + 1e-9:
                         held = need_params

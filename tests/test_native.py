@@ -102,10 +102,9 @@ def test_get_scheduler_native_prefix():
 def test_env_upgrade(monkeypatch):
     monkeypatch.setenv("DLS_NATIVE", "1")
     assert isinstance(get_scheduler("heft"), NativeScheduler)
-    # pipeline has no native twin: falls back to Python
-    assert not isinstance(get_scheduler("pipeline"), NativeScheduler)
+    assert isinstance(get_scheduler("pipeline"), NativeScheduler)
 
 
 def test_native_rejects_unknown_policy():
     with pytest.raises(ValueError, match="no native implementation"):
-        NativeScheduler("pipeline")
+        NativeScheduler("no-such-policy")
