@@ -676,7 +676,6 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
     n_groups = std::max(n_groups, group_ids[t] + 1);
   std::vector<double> compute(n_groups, 0.0), activ(n_groups, 0.0);
   std::vector<std::vector<int32_t>> gparams(n_groups);  // sorted, unique
-  std::vector<uint8_t> seen(g.n_params, 0);
   std::vector<uint8_t> has_root(n_groups, 0);
   for (int t = 0; t < g.n_tasks; ++t) {  // insertion order, like Python
     int gi = group_ids[t];

@@ -54,6 +54,16 @@ class SchedulerRun:
         self.param_locations: Dict[str, Set[str]] = {}
         self.per_node: Dict[str, List[str]] = {d.node_id: [] for d in cluster}
         self.assignment_order: List[str] = []
+        # per-task params in name order, computed once: deterministic float
+        # accumulation (native parity) without re-sorting in the hot loops
+        self._sorted_params: Dict[str, Tuple[str, ...]] = {}
+
+    def sorted_params(self, task) -> Tuple[str, ...]:
+        sp = self._sorted_params.get(task.task_id)
+        if sp is None:
+            sp = tuple(sorted(task.params_needed))
+            self._sorted_params[task.task_id] = sp
+        return sp
 
 
 class BaseScheduler:
@@ -86,7 +96,7 @@ class BaseScheduler:
         """
         need = task.memory_required
         # name order: deterministic float accumulation (native-engine parity)
-        for p in sorted(task.params_needed):
+        for p in run.sorted_params(task):
             if p not in node.cached_params:
                 need += run.graph.param_size_gb(p)
         return need
@@ -102,7 +112,7 @@ class BaseScheduler:
         (schedulers.py:78-126): params stay cached after completion; only
         the activation footprint is returned.
         """
-        for p in sorted(task.params_needed):
+        for p in run.sorted_params(task):
             if p not in node.cached_params:
                 node.cached_params.add(p)
                 node.available_memory -= run.graph.param_size_gb(p)

@@ -60,6 +60,12 @@ class NativeScheduler(BaseScheduler):
         tids = graph.task_ids()
         tidx = {tid: i for i, tid in enumerate(tids)}
         n = len(tids)
+        if n == 0:  # every policy's empty-graph behavior: empty schedule
+            return Schedule(
+                policy=self.policy,
+                per_node={nid: [] for nid in cluster.ids()},
+                scheduling_wall_s=time.perf_counter() - t0,
+            )
         # param ids assigned in sorted-name order: id order == name order,
         # which the engine's tie-breaks rely on
         params = sorted(graph.unique_params())

@@ -89,6 +89,7 @@ class PipelineStageScheduler(BaseScheduler):
         by parked groups), so heterogeneous HBM budgets work.
         """
         groups, compute, activ, gparams = stats or _group_stats(graph)
+        gsorted = [sorted(ps) for ps in gparams]  # name order, sorted ONCE
         n = len(groups)
         k = self.n_stages or min(len(devices), n)
         k = min(k, n, len(devices))
@@ -114,8 +115,8 @@ class PipelineStageScheduler(BaseScheduler):
                 pg = 0.0
                 act = 0.0
                 for i in range(j - 1, s - 2, -1):
-                    # sorted: deterministic float accumulation (native parity)
-                    for p in sorted(gparams[i]):
+                    # name order: deterministic float accumulation (parity)
+                    for p in gsorted[i]:
                         if p not in params:
                             params.add(p)
                             pg += graph.param_size_gb(p)

@@ -1,0 +1,93 @@
+"""The five BASELINE.json configs, exercised end-to-end at real scale.
+
+Each config builds its full-size DAG, schedules it with the named policy on
+the named cluster shape, replays it under the full-fidelity cost model, and
+must complete 100% with a valid schedule.  (Execution timing happens on
+hardware via bench.py; these tests pin the *capability*: every advertised
+configuration schedules and replays cleanly at its real task count.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, DeviceState, get_scheduler, validate_schedule
+from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
+from distributed_llm_scheduler_tpu.sched.heft import HEFTScheduler
+from distributed_llm_scheduler_tpu.sched.pipeline import PipelineStageScheduler
+
+
+def run_config(graph, cluster, scheduler):
+    schedule = scheduler.schedule(graph, cluster)
+    assert not schedule.failed, sorted(schedule.failed)[:3]
+    rep = validate_schedule(graph, cluster, schedule)
+    assert rep.ok, rep.summary()
+    r = SimulatedBackend(fidelity="full").execute(graph, cluster, schedule)
+    assert r.completed_tasks == len(graph)
+    assert r.makespan > 0
+    return r
+
+
+def test_config1_gpt2_small_4dev():
+    """Config #1: GPT-2 small forward DAG, 4 devices (CPU-runnable)."""
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    dag = build_gpt2_dag(GPT2Config.small(), batch=1, seq_len=512)
+    assert len(dag.graph) == 99  # the reference's task count
+    run_config(dag.graph, Cluster.uniform(4, 8.0), get_scheduler("mru"))
+
+
+def test_config2_gpt2_medium_v5e8_heft():
+    """Config #2: GPT-2 medium (355M) on an 8-core mesh, memory-constrained
+    HEFT."""
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    dag = build_gpt2_dag(
+        GPT2Config.medium(dtype=jnp.bfloat16),
+        batch=8, seq_len=512, microbatches=8, vocab_shards=8,
+    )
+    cluster = Cluster([DeviceState(f"core_{i}", 14.0) for i in range(8)])
+    run_config(dag.graph, cluster, HEFTScheduler())
+
+
+def test_config3_llama3_8b_pipeline_v5e16():
+    """Config #3: Llama-3 8B layer-wise DAG, pipeline stages over 16 cores."""
+    from distributed_llm_scheduler_tpu.frontend.llama_dag import build_llama_dag
+    from distributed_llm_scheduler_tpu.models.llama import LlamaConfig
+
+    dag = build_llama_dag(
+        LlamaConfig.llama3_8b(dtype=jnp.bfloat16),
+        batch=16, seq_len=512, microbatches=16, vocab_shards=16,
+    )
+    cluster = Cluster([DeviceState(f"core_{i}", 14.0) for i in range(16)])
+    r = run_config(dag.graph, cluster, PipelineStageScheduler())
+    # the model must actually be spread: one 14 GB core cannot hold 15 GB
+    used = [n for n, t in
+            PipelineStageScheduler().schedule(dag.graph, cluster).per_node.items() if t]
+    assert len(used) >= 2
+
+
+def test_config4_mixtral_experts_hbm_limits():
+    """Config #4: Mixtral MoE DAG, expert tasks under per-core HBM limits."""
+    from distributed_llm_scheduler_tpu.frontend.moe_dag import build_moe_dag
+    from distributed_llm_scheduler_tpu.models.mixtral import MixtralConfig
+
+    # 8x7B-shaped at reduced depth so the CPU test stays fast; full d_model
+    # and all 8 experts per layer — the expert-placement structure is intact
+    cfg = MixtralConfig.mixtral_8x7b(n_layers=4, dtype=jnp.bfloat16)
+    dag = build_moe_dag(cfg, batch=2, seq_len=128)
+    total = dag.graph.total_param_gb()
+    cluster = Cluster.uniform(8, total * 0.3)  # no core can hold the model
+    run_config(dag.graph, cluster, get_scheduler("mru"))
+
+
+def test_config5_gpt2_training_step():
+    """Config #5: GPT-2 training-step DAG (fwd+bwd+opt), activation-aware."""
+    from distributed_llm_scheduler_tpu.frontend.train_dag import build_gpt2_train_dag
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    dag = build_gpt2_train_dag(GPT2Config.small(), batch=4, seq_len=256)
+    run_config(dag.graph, Cluster.uniform(8, 14.0), get_scheduler("heft"))
