@@ -13,10 +13,16 @@ reference *simulates* completion inside ``assign_task_to_node`` (reference
 * a dependency edge whose producer and consumer sit on different cores
   becomes a real device-to-device transfer (ICI on a TPU slice) via
   ``jax.device_put`` of the producer's output;
-* execution is asynchronous dispatch in topological order — XLA queues per
-  device run concurrently, exactly the parallelism the schedule's placement
-  exposes — with a single ``block_until_ready`` fence for makespan, or
-  per-task fences in ``profile`` mode to feed the measured cost model.
+* execution is asynchronous dispatch in the **schedule's order**: each JAX
+  device executes its enqueued ops in FIFO stream order, so the order tasks
+  are dispatched from Python IS the per-device execution order.  Dispatching
+  honors each node's scheduled task list (``Schedule.per_node``), not bare
+  topological order — a policy that computed a 1F1B microbatch interleaving
+  (sched/eventsim.py) gets that interleaving in real execution, where
+  Kahn-wave dispatch would re-introduce the head-of-line blocking the
+  ordering was computed to avoid.  A single ``block_until_ready`` fence
+  measures makespan, or per-task fences in ``profile`` mode feed the
+  measured cost model.
 
 Works identically on a real TPU slice and on the CPU-faked 8-device mesh
 (``--xla_force_host_platform_device_count``), which is how tests exercise
@@ -170,6 +176,72 @@ class DeviceBackend:
         self._run(graph, schedule, placed_params, graph_input, profile=False)
         return time.perf_counter() - t0
 
+    # -- dispatch order ----------------------------------------------------
+    @staticmethod
+    def dispatch_order(graph: TaskGraph, schedule: Schedule) -> List[str]:
+        """Global dispatch linearization honoring per-node scheduled order.
+
+        Per-device XLA streams execute enqueued ops FIFO, so within one node
+        the emitted sequence must be exactly ``schedule.per_node[node]`` —
+        that list is the policy's decided execution order (1F1B interleaving
+        for the pipeline policy).  Across nodes, a task can only be
+        dispatched after its producers (Python needs their output handles,
+        though not their completion — dispatch is async).  Greedy merge:
+        repeatedly emit, among node-queue heads whose deps are all emitted
+        (or unplaced, i.e. failed), the one the scheduler assigned earliest.
+        If per-node orders are mutually inconsistent (a cross-node ordering
+        cycle — no valid policy output does this), the remainder falls back
+        to topological order rather than deadlocking.
+        """
+        placement = schedule.placement
+        topo_pos = {tid: i for i, tid in enumerate(graph.topo_order)}
+        prio = {tid: i for i, tid in enumerate(schedule.assignment_order)}
+        # filter each node's list against `placement` (which keeps the LAST
+        # per_node match): a task erroneously present in two nodes' lists is
+        # dispatched once, on the node placement says, never twice
+        queues = {
+            n: [t for t in lst if t in topo_pos and placement.get(t) == n]
+            for n, lst in schedule.per_node.items()
+            if lst
+        }
+        queues = {n: q for n, q in queues.items() if q}
+        idx = {n: 0 for n in queues}
+        emitted: set = set()
+        order: List[str] = []
+
+        def head_ready(n: str) -> bool:
+            i = idx[n]
+            if i >= len(queues[n]):
+                return False
+            t = queues[n][i]
+            return all(
+                d in emitted or d not in placement
+                for d in graph[t].dependencies
+            )
+
+        total = sum(len(q) for q in queues.values())
+        while len(order) < total:
+            ready_nodes = [n for n in queues if head_ready(n)]
+            if not ready_nodes:
+                break  # inconsistent per-node orders: topo fallback below
+            n = min(
+                ready_nodes,
+                key=lambda n: (
+                    prio.get(
+                        queues[n][idx[n]], topo_pos[queues[n][idx[n]]]
+                    ),
+                    topo_pos[queues[n][idx[n]]],
+                ),
+            )
+            t = queues[n][idx[n]]
+            idx[n] += 1
+            emitted.add(t)
+            order.append(t)
+        order.extend(
+            t for t in graph.topo_order if t in placement and t not in emitted
+        )
+        return order
+
     # -- execution ---------------------------------------------------------
     def _run(
         self,
@@ -186,7 +258,7 @@ class DeviceBackend:
         transfer_bytes = 0
         t_start = time.perf_counter()
 
-        for tid in graph.topo_order:
+        for tid in self.dispatch_order(graph, schedule):
             if tid not in placement:
                 continue  # failed task: skip (fail-and-continue semantics)
             task = graph[tid]
