@@ -142,11 +142,20 @@ class SimulatedBackend:
     """
 
     def __init__(self, fidelity: str = "full", link: Optional[LinkModel] = None,
-                 prefetch_params: bool = True):
+                 prefetch_params: bool = True, host_slots: Optional[int] = None):
         if fidelity not in ("full", "reference"):
             raise ValueError(f"fidelity must be 'full' or 'reference', got {fidelity!r}")
+        if host_slots is not None and host_slots < 1:
+            raise ValueError(f"host_slots must be >= 1, got {host_slots}")
         self.fidelity = fidelity
         self.prefetch_params = prefetch_params and fidelity == "full"
+        # Shared-substrate cap: at most this many tasks execute concurrently
+        # across ALL nodes.  Real TPU cores are independent (None =
+        # unlimited, the default); the CPU-faked mesh shares the host's
+        # cores, so predicting what DeviceBackend will *measure* there
+        # requires capping concurrency at the physical core count — this is
+        # what makes sim-vs-real validation honest on any machine.
+        self.host_slots = host_slots
         if fidelity == "reference":
             # Reference fidelity is *defined* as zero-cost data movement
             # (paper §6.6.1); a caller-supplied link would silently skew
@@ -187,6 +196,14 @@ class SimulatedBackend:
         load_queue_end: Dict[str, float] = {d.node_id: 0.0 for d in cluster}
         param_ready_at: Dict[tuple, float] = {}
 
+        # shared-substrate slots: classic machine model — one heap entry per
+        # slot holding the time that slot next frees up
+        import heapq
+
+        slot_free: list = (
+            [0.0] * self.host_slots if self.host_slots is not None else []
+        )
+
         # Execute in global assignment order (the order the scheduler decided),
         # which respects dependencies by construction.
         for tid in schedule.assignment_order:
@@ -223,7 +240,7 @@ class SimulatedBackend:
                         continue  # failed dep (shouldn't occur for completed)
                     dep_ready = finish[d]
                     if placement.get(d) != node_id:
-                        xfer = self.link.transfer_time(graph[d].memory_required)
+                        xfer = self.link.transfer_time(graph.output_gb(d))
                         dep_ready += xfer
                         transfer_total += xfer
                     start = max(start, dep_ready)
@@ -233,8 +250,16 @@ class SimulatedBackend:
                 else:
                     start += load_time
 
+            if self.host_slots is not None:
+                # earliest-available slot executes this task (greedy in
+                # assignment order — an approximation, but it keeps full
+                # occupancy history unlike dropping finished intervals)
+                start = max(start, heapq.heappop(slot_free))
+
             duration = task.compute_time / speeds[node_id]
             end = start + duration
+            if self.host_slots is not None:
+                heapq.heappush(slot_free, end)
             node_clock[node_id] = end
             finish[tid] = end
             timings[tid] = TaskTiming(tid, node_id, start, end)

@@ -122,12 +122,20 @@ def fuse_linear_chains(
     graph.freeze()
 
     def can_extend(a: str, b: str) -> bool:
-        """b directly follows a in a linear same-group chain."""
+        """b directly follows a in a linear same-group chain.
+
+        ``b`` must actually CONSUME ``a``'s output as its sole fn input:
+        the Task contract allows ``arg_tasks`` to differ from
+        ``dependencies`` (control-only edges, reordered inputs), and fusing
+        such a task would silently feed the predecessor's output into an fn
+        that doesn't want it (ADVICE r1).
+        """
         ta, tb = graph[a], graph[b]
         return (
             len(graph.dependents(a)) == 1
             and len(tb.dependencies) == 1
             and tb.dependencies[0] == a
+            and (tb.arg_tasks is None or tb.arg_tasks == tb.dependencies)
             and ta.group == tb.group
             and (ta.fn is None) == (tb.fn is None)
         )

@@ -65,6 +65,10 @@ class Task:
         identical tasks (every layer's ln1) share ONE fn object, so jit
         compiles each op shape once instead of once per layer.
       out_shape: optional ``jax.ShapeDtypeStruct``-like spec of the output.
+      out_bytes: optional true output size in bytes (set by the pre-flight
+        XLA memory analysis); cost models charge cross-node transfers by
+        this when present, falling back to ``memory_required`` (which also
+        covers temps and so over-charges transfers).
       flops: optional analytic FLOP count (feeds the cost model).
       group: optional label (e.g. layer index) for fusion/visualization.
     """
@@ -79,6 +83,7 @@ class Task:
     arg_tasks: Optional[List[str]] = None
     param_alias: Optional[Dict[str, str]] = None
     out_shape: Optional[Any] = None
+    out_bytes: Optional[int] = None
     flops: Optional[float] = None
     group: Optional[str] = None
 
@@ -290,6 +295,15 @@ class TaskGraph:
 
     def total_param_gb(self) -> float:
         return sum(self.param_size_gb(p) for p in self.unique_params())
+
+    def output_gb(self, tid: str) -> float:
+        """Bytes a consumer actually receives from ``tid``: the task's true
+        output size when known (pre-flight analysis), else its activation
+        footprint (the reference-era proxy, which also counts temps)."""
+        t = self._tasks[tid]
+        if t.out_bytes is not None:
+            return t.out_bytes / GB
+        return t.memory_required
 
     def total_activation_gb(self) -> float:
         return sum(t.memory_required for t in self._tasks.values())

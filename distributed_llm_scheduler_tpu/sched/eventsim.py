@@ -139,7 +139,7 @@ def dependency_aware_order(
             dep_nid = placement[dep]
             arr = finish[tid]
             if dep_nid != nid:
-                arr += link.transfer_time(graph[tid].memory_required)
+                arr += link.transfer_time(graph.output_gb(tid))
             arrival[dep] = max(arrival[dep], arr)
             missing_deps[dep] -= 1
             if missing_deps[dep] == 0:

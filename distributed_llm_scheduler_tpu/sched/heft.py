@@ -47,7 +47,7 @@ class HEFTScheduler(BaseScheduler):
         for tid in reversed(graph.topo_order):
             task = graph[tid]
             w = task.compute_time / mean_speed
-            comm = cross_frac * self.link.transfer_time(task.memory_required)
+            comm = cross_frac * self.link.transfer_time(graph.output_gb(tid))
             best_child = 0.0
             for c in graph.dependents(tid):
                 best_child = max(best_child, comm + rank[c])
@@ -101,7 +101,7 @@ class HEFTScheduler(BaseScheduler):
                     arrive = finish[d]
                     if run.graph[d].assigned_node != nid:
                         arrive += self.link.transfer_time(
-                            run.graph[d].memory_required
+                            run.graph.output_gb(d)
                         )
                     ready = max(ready, arrive)
                 dur = task.compute_time / node.compute_speed

@@ -1,0 +1,288 @@
+"""Bench failure-path tests (VERDICT r1 next #7).
+
+Round 1's number was decided by untested fallback logic (probe timeout ->
+CPU regime).  These tests pin every decision-shaped piece of the bench:
+probe retry/backoff, the 4-step cost-model provenance chain, TPU-time
+derivation, metric naming, link-regime choice, and the JSON payload
+(oracle_ok/fallback flags included per ADVICE r1).
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_llm_scheduler_tpu.eval.benchlib import (
+    BenchResult,
+    choose_cost_model,
+    choose_link,
+    compute_mfu,
+    derive_tpu_costmodel,
+    pick_best,
+    probe_backend,
+    task_class,
+)
+from distributed_llm_scheduler_tpu.utils.costmodel import CostModel
+
+
+# -- probe -------------------------------------------------------------------
+
+
+def test_probe_succeeds_first_try():
+    calls = []
+
+    def fake_run(cmd, timeout):
+        calls.append(timeout)
+
+    assert probe_backend(run=fake_run, sleep=lambda s: None, log=lambda m: None)
+    assert len(calls) == 1
+
+
+def test_probe_retries_with_backoff_then_fails():
+    calls, sleeps = [], []
+
+    def fake_run(cmd, timeout):
+        calls.append(timeout)
+        raise TimeoutError("tunnel hung")
+
+    ok = probe_backend(
+        timeout_s=7,
+        attempts=3,
+        backoff_s=11,
+        run=fake_run,
+        sleep=sleeps.append,
+        log=lambda m: None,
+    )
+    assert not ok
+    assert calls == [7, 7, 7]
+    assert sleeps == [11, 11]  # no sleep after the last attempt
+
+
+def test_probe_recovers_on_second_attempt():
+    state = {"n": 0}
+
+    def flaky_run(cmd, timeout):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise TimeoutError
+
+    assert probe_backend(
+        run=flaky_run, sleep=lambda s: None, log=lambda m: None
+    )
+    assert state["n"] == 2
+
+
+# -- task classes + derivation ----------------------------------------------
+
+
+def test_task_class_strips_mb_layer_shard():
+    assert task_class("mb3_layer_7_attention") == "layer_attention"
+    assert task_class("mb0_layer_0_attention") == "layer_attention"
+    assert task_class("mb0_embedding_shard_2") == "embedding"
+    assert task_class("mb7_output_projection") == "output_projection"
+    assert task_class("output_concat") == "output_concat"
+
+
+def test_derive_tpu_costmodel_uses_class_ratios():
+    base_cpu = CostModel("base", "cpu", {
+        "mb0_layer_0_attention": 1.0,
+        "mb1_layer_0_attention": 1.0,
+        "mb0_embedding": 0.5,
+    })
+    base_tpu = CostModel("base", "tpu", {
+        "mb0_layer_0_attention": 0.01,   # attention ratio 1/100
+        "mb1_layer_0_attention": 0.01,
+        "mb0_embedding": 0.025,          # embedding ratio 1/20
+    })
+    target_cpu = CostModel("target", "cpu", {
+        "mb0_layer_5_attention": 2.0,    # class match -> /100
+        "mb0_embedding_shard_3": 0.2,    # shard -> embedding class -> /20
+        "mb0_novel_op": 1.0,             # no class -> global median
+    })
+    derived = derive_tpu_costmodel(target_cpu, base_cpu, base_tpu)
+    assert derived.platform == "tpu_derived"
+    assert derived.task_seconds["mb0_layer_5_attention"] == pytest.approx(0.02)
+    assert derived.task_seconds["mb0_embedding_shard_3"] == pytest.approx(0.01)
+    # global median of [0.01, 0.01, 0.05] = 0.01
+    assert derived.task_seconds["mb0_novel_op"] == pytest.approx(0.01)
+
+
+def test_derive_rejects_disjoint_bases():
+    with pytest.raises(ValueError):
+        derive_tpu_costmodel(
+            CostModel("t", "cpu", {"a": 1.0}),
+            CostModel("b", "cpu", {"x": 1.0}),
+            CostModel("b", "tpu", {"y": 1.0}),
+        )
+
+
+# -- cost-model provenance chain --------------------------------------------
+
+
+class _FakeDevice:
+    def __init__(self, platform):
+        self.platform = platform
+
+
+def _graph(name, tids):
+    from distributed_llm_scheduler_tpu import Task, TaskGraph
+
+    return TaskGraph([Task(t, 0.1, 1.0, []) for t in tids], name=name).freeze()
+
+
+def test_choose_cost_model_prefers_cached_tpu(tmp_path, monkeypatch):
+    g = _graph("flagship", ["a", "b"])
+    cached = CostModel("flagship", "tpu", {"a": 0.001, "b": 0.002})
+    cached.save(str(tmp_path / "flagship_tpu.json"))
+    cm, suffix = choose_cost_model(
+        g, {}, None, _FakeDevice("cpu"), cache_dir=str(tmp_path),
+        log=lambda m: None,
+    )
+    assert suffix == "_tpu_cached"
+    assert cm.task_seconds == cached.task_seconds
+
+
+def test_choose_cost_model_stale_cache_falls_through(tmp_path, monkeypatch):
+    """A cached TPU calibration whose task set mismatches must NOT be used
+    (the round-1 failure mode was silently wrong regimes)."""
+    g = _graph("flagship", ["a", "b"])
+    CostModel("flagship", "tpu", {"a": 0.001}).save(
+        str(tmp_path / "flagship_tpu.json")
+    )
+
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+        return CostModel(graph.name, device.platform, {"a": 1.0, "b": 1.0})
+
+    monkeypatch.setattr(
+        "distributed_llm_scheduler_tpu.utils.costmodel.calibrate_cached",
+        fake_calibrate_cached,
+    )
+    cm, suffix = choose_cost_model(
+        g, {}, None, _FakeDevice("cpu"), cache_dir=str(tmp_path),
+        log=lambda m: None,
+    )
+    assert suffix == "_cpu"
+    assert cm.platform == "cpu"
+
+
+def test_choose_cost_model_derives_from_base_pair(tmp_path, monkeypatch):
+    g = _graph("flagship", ["mb0_layer_0_attention"])
+    CostModel("base", "cpu", {"mb0_layer_0_attention": 1.0}).save(
+        str(tmp_path / "base_cpu.json")
+    )
+    CostModel("base", "tpu", {"mb0_layer_0_attention": 0.01}).save(
+        str(tmp_path / "base_tpu.json")
+    )
+
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+        return CostModel(
+            graph.name, device.platform, {"mb0_layer_0_attention": 2.0}
+        )
+
+    monkeypatch.setattr(
+        "distributed_llm_scheduler_tpu.utils.costmodel.calibrate_cached",
+        fake_calibrate_cached,
+    )
+    cm, suffix = choose_cost_model(
+        g, {}, None, _FakeDevice("cpu"), cache_dir=str(tmp_path),
+        base_graph_name="base", log=lambda m: None,
+    )
+    assert suffix == "_tpu_derived"
+    assert cm.task_seconds["mb0_layer_0_attention"] == pytest.approx(0.02)
+
+
+def test_choose_cost_model_cpu_last_resort(tmp_path, monkeypatch):
+    g = _graph("flagship", ["a"])
+
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+        return CostModel(graph.name, device.platform, {"a": 1.0})
+
+    monkeypatch.setattr(
+        "distributed_llm_scheduler_tpu.utils.costmodel.calibrate_cached",
+        fake_calibrate_cached,
+    )
+    cm, suffix = choose_cost_model(
+        g, {}, None, _FakeDevice("cpu"), cache_dir=str(tmp_path),
+        log=lambda m: None,
+    )
+    assert suffix == "_cpu"
+
+
+# -- link regime -------------------------------------------------------------
+
+
+def test_choose_link_tpu_regime_uses_cached_tpu_calibration(tmp_path):
+    from distributed_llm_scheduler_tpu.utils.linkmodel import LinkCalibration
+
+    cal = LinkCalibration(platform="tpu")
+    cal.param_load_gbps = 17.0
+    cal.provenance["param_load"] = "measured"
+    cal.save(str(tmp_path / "link_tpu.json"))
+    for suffix in ("", "_tpu_cached", "_tpu_derived"):
+        link, prov = choose_link(suffix, cache_dir=str(tmp_path))
+        assert link.param_load_gbps == 17.0
+        assert prov.startswith("tpu:")
+
+
+def test_choose_link_tpu_regime_estimates_when_unmeasured(tmp_path):
+    link, prov = choose_link("", cache_dir=str(tmp_path))
+    assert prov == "tpu:estimated(v5e)"
+    assert link.interconnect_gbps == 100.0
+
+
+# -- result shaping ----------------------------------------------------------
+
+
+def test_pick_best_ignores_incomplete_policies():
+    ms = {
+        "roundrobin": (10.0, 1.0),
+        "fast_but_broken": (1.0, 0.5),
+        "heft": (4.0, 1.0),
+    }
+    name, best, rr = pick_best(ms)
+    assert (name, best, rr) == ("heft", 4.0, 10.0)
+
+
+def test_pick_best_all_incomplete_returns_baseline():
+    ms = {"roundrobin": (10.0, 0.9), "heft": (4.0, 0.8)}
+    assert pick_best(ms) == ("roundrobin", 10.0, 10.0)
+
+
+def test_compute_mfu_only_for_known_peaks():
+    assert compute_mfu(197e12, 1.0, "tpu", "bfloat16") == pytest.approx(1.0)
+    assert compute_mfu(1e12, 1.0, "cpu", "float32") is None
+    assert compute_mfu(0.0, 1.0, "tpu", "bfloat16") is None
+
+
+def test_bench_result_payload_flags_degraded_runs():
+    r = BenchResult(
+        n_policies=7,
+        platform_suffix="_tpu_derived",
+        best_policy="pipeline",
+        best_makespan_s=0.010,
+        baseline_makespan_s=0.025,
+        oracle_ok=False,
+        fallback=True,
+        link_provenance="tpu:estimated(v5e)",
+    )
+    payload = r.to_json()
+    assert payload["metric"] == (
+        "gpt2s_fwd_dag_makespan_best_of_7_policies_tpu_derived"
+    )
+    assert payload["vs_baseline"] == pytest.approx(2.5)
+    assert payload["oracle_ok"] is False
+    assert payload["fallback"] is True
+    assert payload["best_policy"] == "pipeline"
+    json.dumps(payload)  # must be serializable as-is
+
+
+def test_bench_result_tpu_measured_metric_has_no_suffix():
+    r = BenchResult(
+        n_policies=7,
+        platform_suffix="",
+        best_policy="pipeline",
+        best_makespan_s=0.010,
+        baseline_makespan_s=0.015,
+    )
+    assert r.metric == "gpt2s_fwd_dag_makespan_best_of_7_policies"
+    assert r.to_json()["fallback"] is False

@@ -113,3 +113,31 @@ def test_schedulers_run_on_fused_graph():
         s = get_scheduler(name).schedule(fused, Cluster.uniform(4, 8.0))
         assert not s.failed
         assert len(s.completed) == len(fused)
+
+
+def test_control_only_edge_not_fused():
+    """A task whose arg_tasks differ from its dependencies (control-only
+    edge: it does NOT consume the predecessor's output) must never be
+    fused into a chain (ADVICE r1)."""
+    from distributed_llm_scheduler_tpu import Task, TaskGraph
+
+    def produce(pd):
+        import jax.numpy as jnp
+
+        return jnp.ones((2, 2))
+
+    def consume(pd, x):
+        return x * 2
+
+    g = TaskGraph(
+        [
+            Task("a", 0.1, 1.0, [], fn=lambda pd, x: x + 1),
+            # b depends on a for ORDER ONLY; its fn takes no dep outputs
+            Task("b", 0.1, 1.0, ["a"], fn=produce, arg_tasks=[]),
+            Task("c", 0.1, 1.0, ["b"], fn=consume),
+        ],
+        name="ctrl",
+    ).freeze()
+    fused = fuse_linear_chains(g)
+    # a -> b must not fuse (b ignores a's output); b -> c may fuse
+    assert "a" in fused.task_ids()

@@ -1,0 +1,146 @@
+"""Measured link model + sim-vs-real validation (VERDICT r1 #3).
+
+The replay's LinkModel constants were invented in round 1; these tests pin
+the calibration machinery (affine fit, provenance, cache staleness) and the
+headline property: with a measured cost model and a measured link, the
+simulated backend's predicted makespan tracks the device backend's measured
+makespan within a stated tolerance, for multiple policies.
+"""
+
+import os
+
+import jax
+import pytest
+
+import distributed_llm_scheduler_tpu as dls
+from distributed_llm_scheduler_tpu import Cluster
+from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
+from distributed_llm_scheduler_tpu.utils.linkmodel import (
+    EST_ICI_GBPS,
+    LinkCalibration,
+    _fit_affine,
+    calibrate_link,
+    calibrate_link_cached,
+)
+
+GB = 1024**3
+
+
+def test_fit_affine_recovers_known_line():
+    lat, bw_gb = 20e-6, 5.0
+    samples = [
+        (s, lat + s / (bw_gb * GB))
+        for s in (1 << 10, 1 << 16, 1 << 22, 1 << 26)
+    ]
+    got_lat, got_bw = _fit_affine(samples)
+    assert got_lat == pytest.approx(lat, rel=1e-6)
+    assert got_bw == pytest.approx(bw_gb, rel=1e-6)
+
+
+def test_fit_affine_noise_clamps_sane():
+    # pure-noise samples (no size dependence) must not yield negative
+    # latency or bandwidth
+    samples = [(1 << 10, 1e-5), (1 << 20, 1e-5), (1 << 24, 1e-5)]
+    lat, bw = _fit_affine(samples)
+    assert lat >= 0
+    assert bw > 0
+
+
+@pytest.fixture(scope="module")
+def link_cal():
+    # small sizes keep the sweep fast; both legs measurable on the 8-device
+    # CPU mesh
+    return calibrate_link(
+        jax.devices(), sizes=(1 << 12, 1 << 16, 1 << 20, 1 << 23), repeats=3
+    )
+
+
+def test_calibrate_link_measures_both_legs(link_cal):
+    assert link_cal.provenance["param_load"] == "measured"
+    assert link_cal.provenance["interconnect"] == "measured"
+    assert link_cal.param_load_gbps > 0
+    assert link_cal.interconnect_gbps > 0
+    assert link_cal.latency_s >= 0
+    # samples persisted for audit
+    assert len(link_cal.samples["param_load"]) == 4
+
+
+def test_calibration_roundtrips(tmp_path, link_cal):
+    p = str(tmp_path / "link_cpu.json")
+    link_cal.save(p)
+    back = LinkCalibration.load(p)
+    assert back.param_load_gbps == link_cal.param_load_gbps
+    assert back.provenance == link_cal.provenance
+    lm = back.to_link_model()
+    assert lm.param_load_gbps == link_cal.param_load_gbps
+
+
+def test_cached_calibration_refreshes_estimated_interconnect(tmp_path):
+    """A cache written with 1 device (interconnect estimated) must be
+    re-measured once sibling devices exist — otherwise the invented ICI
+    estimate masquerades as calibration forever."""
+    cache = str(tmp_path)
+    stale = LinkCalibration(platform="cpu")  # provenance: both estimated
+    stale.param_load_gbps = 123.0
+    stale.save(os.path.join(cache, "link_cpu.json"))
+    cal = calibrate_link_cached(cache_dir=cache, repeats=2)
+    assert cal.provenance["interconnect"] == "measured"
+    assert cal.param_load_gbps != 123.0
+    # and a *measured* cache is trusted as-is
+    again = calibrate_link_cached(cache_dir=cache, repeats=2)
+    assert again.param_load_gbps == cal.param_load_gbps
+
+
+def test_single_device_leaves_interconnect_estimated():
+    cal = calibrate_link(
+        jax.devices()[:1], sizes=(1 << 12, 1 << 18), repeats=2
+    )
+    assert cal.provenance["param_load"] == "measured"
+    assert cal.provenance["interconnect"] == "estimated"
+    assert cal.interconnect_gbps == EST_ICI_GBPS
+
+
+# -- sim-vs-real ------------------------------------------------------------
+
+
+def test_sim_tracks_real_execution():
+    """For >=3 policies on the 8-device CPU mesh: SimulatedBackend with a
+    measured cost model + measured link + host-core concurrency cap must
+    predict DeviceBackend's measured makespan within +/-40%.
+
+    Tolerance rationale: profile-mode calibration measures per-task wall
+    times with fences (slight overestimate), async measured runs overlap
+    dispatch (slight underestimate), and CPU-mesh noise is a few percent;
+    observed prediction ratios on a 1-core host are 0.88-1.02, so 40% has
+    >3x headroom without being vacuous."""
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+    from distributed_llm_scheduler_tpu.utils.costmodel import calibrate
+
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=4, seq_len=64)
+    params, ids = dag.init_params(), dag.make_inputs()
+    g = dag.graph
+    cal = calibrate_link(
+        jax.devices(), sizes=(1 << 14, 1 << 18, 1 << 22), repeats=3
+    )
+    calibrate(g, params, ids, repeats=2).apply(g)
+
+    cluster = Cluster.from_jax_devices(hbm_cap_gb=4.0)
+    backend = DeviceBackend(cluster)
+    sim = SimulatedBackend(
+        fidelity="full",
+        link=cal.to_link_model(),
+        host_slots=os.cpu_count() or 1,
+    )
+    ratios = {}
+    for policy in ("roundrobin", "pipeline", "critical"):
+        s = dls.get_scheduler(policy).schedule(g, cluster)
+        predicted = sim.execute(g, cluster, s).makespan
+        backend.execute(g, s, params, ids)  # warm
+        measured = min(
+            backend.execute(g, s, params, ids, warmup=False).makespan_s
+            for _ in range(3)
+        )
+        ratios[policy] = predicted / measured
+    assert all(0.6 <= r <= 1.4 for r in ratios.values()), ratios

@@ -101,3 +101,27 @@ def test_utilization_bounded(diamond_graph, two_nodes):
     rep = run(diamond_graph, two_nodes, "critical")
     for v in rep.node_utilization.values():
         assert 0.0 <= v <= 1.0 + 1e-9
+
+
+def test_host_slots_caps_concurrency():
+    """host_slots models a shared execution substrate (the CPU-faked mesh):
+    8 independent 1s tasks on 8 nodes run in 1s unlimited, ~4s with 2
+    slots, ~8s with 1 slot."""
+    from distributed_llm_scheduler_tpu import Cluster, DeviceState
+
+    g = TaskGraph(
+        [Task(f"t{i}", 0.1, 1.0, [], set()) for i in range(8)], name="indep"
+    ).freeze()
+    cluster = Cluster([DeviceState(f"n{i}", 4.0) for i in range(8)])
+    sched = get_scheduler("roundrobin").schedule(g, cluster)
+    link = LinkModel(param_load_gbps=None, interconnect_gbps=None, latency_s=0.0)
+
+    def makespan(slots):
+        sim = SimulatedBackend(fidelity="full", link=link, host_slots=slots)
+        return sim.execute(g, cluster, sched).makespan
+
+    assert makespan(None) == pytest.approx(1.0)
+    assert makespan(2) == pytest.approx(4.0)
+    assert makespan(1) == pytest.approx(8.0)
+    with pytest.raises(ValueError):
+        SimulatedBackend(host_slots=0)
