@@ -26,6 +26,9 @@ _ABI_VERSION = 2
 _lock = threading.Lock()
 _engine: Optional[ctypes.CDLL] = None
 _load_error: Optional[str] = None
+_transient_attempts = 0
+_MAX_TRANSIENT_ATTEMPTS = 3
+_warned = False
 
 POLICY_IDS = {
     "roundrobin": 0,
@@ -82,10 +85,31 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _is_transient(e: BaseException) -> bool:
+    """Failures worth retrying on a later call: compile timeouts (loaded
+    machine), OS-level hiccups (disk full, OOM-killed g++ surfaces as
+    CalledProcessError with empty stderr or as OSError).  A real compile
+    error (non-empty stderr) is deterministic and cached permanently."""
+    cause = e.__cause__
+    if isinstance(cause, subprocess.TimeoutExpired) or isinstance(
+        cause, OSError
+    ):
+        return True
+    if isinstance(cause, subprocess.CalledProcessError):
+        return not (cause.stderr or "").strip()
+    return isinstance(e, OSError)
+
+
 def load_engine() -> ctypes.CDLL:
     """The bound engine library; compiles on first call.  Raises on failure
-    (callers wanting graceful fallback use :func:`available`)."""
-    global _engine, _load_error
+    (callers wanting graceful fallback use :func:`available`).
+
+    Transient build failures (timeout/OS errors) are retried on later
+    calls, up to ``_MAX_TRANSIENT_ATTEMPTS``, instead of permanently
+    disabling the engine for the process (ADVICE r1 #4: a single
+    OOM-killed g++ used to silently hide an 11-19x scheduling slowdown).
+    """
+    global _engine, _load_error, _transient_attempts
     with _lock:
         if _engine is not None:
             return _engine
@@ -103,17 +127,35 @@ def load_engine() -> ctypes.CDLL:
                 )
             _engine = lib
             return lib
-        except Exception as e:  # record, so we don't retry every call
-            _load_error = str(e)
+        except Exception as e:
+            _transient_attempts += 1
+            if (
+                _is_transient(e)
+                and _transient_attempts < _MAX_TRANSIENT_ATTEMPTS
+            ):
+                raise  # leave _load_error unset: next call retries
+            _load_error = str(e)  # deterministic (or retries exhausted)
             raise
 
 
 def available() -> bool:
-    """True if the native engine can be (or already was) loaded."""
+    """True if the native engine can be (or already was) loaded.
+
+    Logs a one-time stderr warning on the first falsy return so a
+    DLS_NATIVE=1 run that silently degrades to the pure-Python policies
+    is visible (ADVICE r1 #4)."""
+    global _warned
     try:
         load_engine()
         return True
-    except Exception:
+    except Exception as e:
+        if not _warned:
+            _warned = True
+            print(
+                f"distributed_llm_scheduler_tpu: native engine unavailable, "
+                f"falling back to pure-Python schedulers ({e})",
+                file=sys.stderr,
+            )
         return False
 
 

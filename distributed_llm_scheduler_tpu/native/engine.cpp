@@ -386,8 +386,12 @@ void run_mru(Run& run) {
           int overlap = 0;
           for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
             if (r.is_cached(node, g.par_ids[k])) ++overlap;
-          double score = W_OVERLAP * overlap + r.avail[node] +
-                         W_FITS_AFTER_EVICT -
+          // Reference conditional scoring: available memory only when the
+          // task fits without eviction, the flat bonus only when eviction
+          // is needed (mirrors policies.py MRU pick).
+          double score = W_OVERLAP * overlap +
+                         (plan.evict.empty() ? r.avail[node]
+                                             : W_FITS_AFTER_EVICT) -
                          W_LOAD_PENALTY * r.completed_on[node];
           if (best < 0 || score > best_score) {
             best = node;

@@ -140,8 +140,9 @@ class MRUScheduler(BaseScheduler):
     eviction score (higher = keep) is
     ``10*frequency + 100/(recency+1) + 1000 if needed by any ready pending
     task`` (reference ``schedulers.py:383-402``).  Node choice scores
-    ``20*cached-param-overlap + available_memory + 5 if the task fits after
-    eviction - 0.5*completed-task count`` (reference ``schedulers.py:444-525``),
+    ``20*cached-param-overlap + (available_memory if the task fits without
+    eviction else 5) - 0.5*completed-task count`` (reference
+    ``schedulers.py:444-525`` — the two bonuses are mutually exclusive),
     and ready tasks are ordered by how many pending dependents they unblock.
     """
 
@@ -211,14 +212,15 @@ class MRUScheduler(BaseScheduler):
                 if plan is None:
                     continue  # cannot fit even after eviction
                 overlap = len(task.params_needed & node.cached_params)
-                # Candidate nodes all fit after eviction by construction, so
-                # the reference's "+5 if fits after eviction" bonus
-                # (schedulers.py:487) is a constant among candidates; keep it
-                # for score-value parity, not ranking effect.
+                # Reference conditional scoring (schedulers.py:487-493):
+                # a node that fits WITHOUT eviction earns its available
+                # memory; one that needs eviction earns only the flat +5.
+                # The two bonuses are mutually exclusive — an empty plan
+                # means no eviction needed (ADVICE r1 #3).
                 score = (
                     self.W_OVERLAP * overlap
-                    + node.available_memory
-                    + self.W_FITS_AFTER_EVICT
+                    + (node.available_memory if not plan
+                       else self.W_FITS_AFTER_EVICT)
                     - self.W_LOAD_PENALTY * len(node.completed_tasks)
                 )
                 if best_score is None or score > best_score:
