@@ -49,10 +49,52 @@ class LinkModel:
             return 0.0
         return self.latency_s + gb / self.param_load_gbps
 
-    def transfer_time(self, gb: float) -> float:
+    def transfer_time(
+        self,
+        gb: float,
+        src_slice: Optional[int] = None,
+        dst_slice: Optional[int] = None,
+    ) -> float:
+        """Device-to-device transfer cost.  The slice arguments exist for
+        topology-aware subclasses (:class:`TieredLinkModel`); the flat model
+        charges every hop at ICI rate regardless."""
         if self.interconnect_gbps is None:
             return 0.0
         return self.latency_s + gb / self.interconnect_gbps
+
+
+@dataclass
+class TieredLinkModel(LinkModel):
+    """Two-tier interconnect: ICI within a slice, DCN between slices.
+
+    BASELINE config #3 ("v5e-16, DCN-aware") is two v5e-8 slices joined by
+    data-center network: intra-slice hops keep ``interconnect_gbps``;
+    cross-slice hops pay ``dcn_gbps`` + ``dcn_latency_s`` (defaults are
+    v5e-class estimates: ~12.5 GB/s effective per-host DCN, tens of us
+    latency — an order of magnitude below ICI, which is the whole point).
+    Call sites without slice information (``None``) are charged the ICI
+    tier, so single-slice users never see DCN costs by accident.
+    """
+
+    dcn_gbps: Optional[float] = 12.5
+    dcn_latency_s: float = 50e-6
+
+    def transfer_time(
+        self,
+        gb: float,
+        src_slice: Optional[int] = None,
+        dst_slice: Optional[int] = None,
+    ) -> float:
+        cross = (
+            src_slice is not None
+            and dst_slice is not None
+            and src_slice != dst_slice
+        )
+        if not cross:
+            return super().transfer_time(gb)
+        if self.dcn_gbps is None:
+            return 0.0
+        return self.dcn_latency_s + gb / self.dcn_gbps
 
 
 @dataclass
@@ -240,7 +282,11 @@ class SimulatedBackend:
                         continue  # failed dep (shouldn't occur for completed)
                     dep_ready = finish[d]
                     if placement.get(d) != node_id:
-                        xfer = self.link.transfer_time(graph.output_gb(d))
+                        xfer = self.link.transfer_time(
+                            graph.output_gb(d),
+                            src_slice=cluster[placement[d]].slice_id,
+                            dst_slice=cluster[node_id].slice_id,
+                        )
                         dep_ready += xfer
                         transfer_total += xfer
                     start = max(start, dep_ready)

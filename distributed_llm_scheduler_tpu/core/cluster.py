@@ -33,12 +33,18 @@ class DeviceState:
     tracked by the MRU policy itself under its logical clock (the reference
     also keeps a per-node deque, ``schedulers.py:28``, but its scheduler
     reads its own usage dicts — we keep only the read path).
+
+    ``slice_id`` is the device's TPU slice (pod) membership: transfers
+    between cores of the same slice ride ICI; transfers between slices ride
+    the much slower DCN (:class:`~..backends.sim.TieredLinkModel`).  The
+    reference has no notion of network topology at all.
     """
 
     node_id: str
     total_memory: float  # GB
     compute_speed: float = 1.0
     jax_device: Optional[Any] = None
+    slice_id: int = 0
 
     available_memory: float = field(init=False)
     cached_params: Set[str] = field(default_factory=set)
@@ -137,6 +143,25 @@ class Cluster:
         ])
 
     @classmethod
+    def multislice(cls, n_slices: int, cores_per_slice: int,
+                   memory_gb: float, speed: float = 1.0,
+                   prefix: str = "core") -> "Cluster":
+        """Multi-slice TPU topology (BASELINE config #3: 2 x v5e-8 = 16
+        cores, DCN between slices).  Devices are ordered slice-by-slice, so
+        contiguous pipeline stages cross DCN only at slice boundaries."""
+        return cls([
+            DeviceState(
+                f"{prefix}_{s}_{i}", memory_gb, speed, slice_id=s
+            )
+            for s in range(n_slices)
+            for i in range(cores_per_slice)
+        ])
+
+    def slice_ids(self) -> Dict[str, int]:
+        """node_id -> slice_id (for topology-aware cost call sites)."""
+        return {d.node_id: d.slice_id for d in self.devices}
+
+    @classmethod
     def laptops(cls) -> "Cluster":
         """The reference's 4-laptop fleet (reference test_gpt2.py:278-283)."""
         profile = [("laptop_0", 8.0, 1.0), ("laptop_1", 8.0, 1.2),
@@ -167,7 +192,10 @@ class Cluster:
                     cap = limit / 1024**3 if limit else 16.0
                 except Exception:
                     cap = 16.0
-            out.append(DeviceState(f"core_{i}", cap, 1.0, jax_device=dev))
+            out.append(DeviceState(
+                f"core_{i}", cap, 1.0, jax_device=dev,
+                slice_id=getattr(dev, "slice_index", None) or 0,
+            ))
         return cls(out)
 
     def __repr__(self) -> str:

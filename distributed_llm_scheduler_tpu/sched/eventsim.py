@@ -41,6 +41,7 @@ def dependency_aware_order(
     placement: Dict[str, str],
     speeds: Optional[Dict[str, float]] = None,
     link: Optional[LinkModel] = None,
+    slices: Optional[Dict[str, int]] = None,
 ) -> List[str]:
     """Order placed tasks to minimize head-of-line blocking.
 
@@ -51,6 +52,9 @@ def dependency_aware_order(
       speeds: node_id -> compute speed (default 1.0).
       link: cost model for cross-node dependency transfers and parameter
         loads (defaults to :class:`LinkModel` defaults).
+      slices: node_id -> slice_id (``Cluster.slice_ids()``); lets a
+        :class:`~..backends.sim.TieredLinkModel` charge DCN on cross-slice
+        edges.  Omitted: every hop is charged at the ICI tier.
 
     Returns:
       All placed task_ids ordered by simulated start time (ties broken by
@@ -58,6 +62,7 @@ def dependency_aware_order(
     """
     link = link or LinkModel()
     speeds = speeds or {}
+    slices = slices or {}
     topo_pos = {tid: i for i, tid in enumerate(graph.topo_order)}
     depth = graph.depths()
 
@@ -139,7 +144,11 @@ def dependency_aware_order(
             dep_nid = placement[dep]
             arr = finish[tid]
             if dep_nid != nid:
-                arr += link.transfer_time(graph.output_gb(tid))
+                arr += link.transfer_time(
+                    graph.output_gb(tid),
+                    src_slice=slices.get(nid),
+                    dst_slice=slices.get(dep_nid),
+                )
             arrival[dep] = max(arrival[dep], arr)
             missing_deps[dep] -= 1
             if missing_deps[dep] == 0:

@@ -99,9 +99,15 @@ class HEFTScheduler(BaseScheduler):
                         ready = max(ready, q_end)
                 for d in task.dependencies:
                     arrive = finish[d]
-                    if run.graph[d].assigned_node != nid:
+                    dep_nid = run.graph[d].assigned_node
+                    if dep_nid != nid:
+                        # topology-aware: cross-slice edges pay the DCN
+                        # tier under a TieredLinkModel, so EFT naturally
+                        # prefers keeping chatty edges inside a slice
                         arrive += self.link.transfer_time(
-                            run.graph.output_gb(d)
+                            run.graph.output_gb(d),
+                            src_slice=cluster[dep_nid].slice_id,
+                            dst_slice=node.slice_id,
                         )
                     ready = max(ready, arrive)
                 dur = task.compute_time / node.compute_speed

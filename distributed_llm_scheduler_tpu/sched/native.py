@@ -47,6 +47,16 @@ class NativeScheduler(BaseScheduler):
             link.interconnect_gbps or 0.0,
             link.latency_s,
         )
+        # the C ABI carries a single flat link tier; a tiered (ICI/DCN)
+        # model would be silently flattened to ICI — refuse rather than
+        # let heft/pipeline optimize the wrong costs on multislice clusters
+        from ..backends.sim import TieredLinkModel
+
+        if isinstance(link, TieredLinkModel):
+            raise ValueError(
+                "NativeScheduler supports flat LinkModel only; use the "
+                "Python policies for TieredLinkModel (DCN-aware) runs"
+            )
 
     def schedule(self, graph: TaskGraph, cluster: Cluster) -> Schedule:
         from ..native import POLICY_IDS, load_engine

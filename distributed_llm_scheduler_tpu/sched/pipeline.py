@@ -288,7 +288,8 @@ class PipelineStageScheduler(BaseScheduler):
         }
         speeds = {d.node_id: d.compute_speed for d in run.cluster}
         order = dependency_aware_order(
-            run.graph, placement, speeds, self.link
+            run.graph, placement, speeds, self.link,
+            slices=run.cluster.slice_ids(),
         )
         run.assignment_order[:] = order
         pos = {tid: i for i, tid in enumerate(order)}

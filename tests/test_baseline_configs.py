@@ -18,15 +18,17 @@ from distributed_llm_scheduler_tpu.sched.heft import HEFTScheduler
 from distributed_llm_scheduler_tpu.sched.pipeline import PipelineStageScheduler
 
 
-def run_config(graph, cluster, scheduler):
+def run_config(graph, cluster, scheduler, link=None):
     schedule = scheduler.schedule(graph, cluster)
     assert not schedule.failed, sorted(schedule.failed)[:3]
     rep = validate_schedule(graph, cluster, schedule)
     assert rep.ok, rep.summary()
-    r = SimulatedBackend(fidelity="full").execute(graph, cluster, schedule)
+    r = SimulatedBackend(fidelity="full", link=link).execute(
+        graph, cluster, schedule
+    )
     assert r.completed_tasks == len(graph)
     assert r.makespan > 0
-    return r
+    return r, schedule
 
 
 def test_config1_gpt2_small_4dev():
@@ -54,7 +56,10 @@ def test_config2_gpt2_medium_v5e8_heft():
 
 
 def test_config3_llama3_8b_pipeline_v5e16():
-    """Config #3: Llama-3 8B layer-wise DAG, pipeline stages over 16 cores."""
+    """Config #3: Llama-3 8B layer-wise DAG, pipeline stages over two v5e-8
+    slices (16 cores), DCN-aware: cross-slice edges are charged at the DCN
+    tier and the contiguous slice-ordered stages keep them rare."""
+    from distributed_llm_scheduler_tpu.backends.sim import TieredLinkModel
     from distributed_llm_scheduler_tpu.frontend.llama_dag import build_llama_dag
     from distributed_llm_scheduler_tpu.models.llama import LlamaConfig
 
@@ -62,12 +67,27 @@ def test_config3_llama3_8b_pipeline_v5e16():
         LlamaConfig.llama3_8b(dtype=jnp.bfloat16),
         batch=16, seq_len=512, microbatches=16, vocab_shards=16,
     )
-    cluster = Cluster([DeviceState(f"core_{i}", 14.0) for i in range(16)])
-    r = run_config(dag.graph, cluster, PipelineStageScheduler())
+    cluster = Cluster.multislice(2, 8, 14.0)  # 2 x v5e-8, DCN between
+    link = TieredLinkModel()
+    r, schedule = run_config(
+        dag.graph, cluster, PipelineStageScheduler(link=link), link=link
+    )
     # the model must actually be spread: one 14 GB core cannot hold 15 GB
-    used = [n for n, t in
-            PipelineStageScheduler().schedule(dag.graph, cluster).per_node.items() if t]
+    used = [n for n, t in schedule.per_node.items() if t]
     assert len(used) >= 2
+
+    # contiguous slice-ordered stages: only a small fraction of dependency
+    # edges may cross the DCN boundary (round-robin would cross on ~half)
+    slices = cluster.slice_ids()
+    cross = total = 0
+    for t in dag.graph:
+        for d in t.dependencies:
+            if t.task_id in schedule.placement and d in schedule.placement:
+                total += 1
+                if (slices[schedule.placement[t.task_id]]
+                        != slices[schedule.placement[d]]):
+                    cross += 1
+    assert total > 0 and cross / total < 0.15, (cross, total)
 
 
 def test_config4_mixtral_experts_hbm_limits():
