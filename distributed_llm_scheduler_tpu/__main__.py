@@ -141,13 +141,15 @@ def cmd_visualize(args) -> int:
     dag = cfg.build_graph()
     graph = getattr(dag, "graph", dag)
     print("dag ->", visualize_dag(
-        graph, f"{cfg.out_dir}/{graph.name}.dag.png", detailed=args.detailed
+        graph, f"{cfg.out_dir}/{graph.name}.dag.png", detailed=args.detailed,
+        show=args.show,
     ))
     cluster = cfg.build_cluster()
     schedule = get_scheduler(cfg.scheduler).schedule(graph, cluster)
     _replay_backend(cfg).execute(graph, cluster, schedule)
     print("gantt ->", visualize_schedule(
-        schedule, f"{cfg.out_dir}/{graph.name}.{cfg.scheduler}.gantt.png"
+        schedule, f"{cfg.out_dir}/{graph.name}.{cfg.scheduler}.gantt.png",
+        show=args.show,
     ))
     return 0
 
@@ -226,6 +228,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("visualize", help="render DAG + Gantt PNGs")
     _add_common(p)
     p.add_argument("--detailed", action="store_true")
+    p.add_argument("--show", action="store_true",
+                   help="also open figures in a window (interactive analog "
+                        "of the reference's visu menu)")
     p.set_defaults(fn=cmd_visualize)
 
     p = sub.add_parser("train", help="run sharded training steps")

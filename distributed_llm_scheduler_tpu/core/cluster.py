@@ -20,6 +20,7 @@ and an MRU recency deque.  TPU-first differences:
 from __future__ import annotations
 
 import random
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Set
 
@@ -50,6 +51,13 @@ class DeviceState:
     cached_params: Set[str] = field(default_factory=set)
     running_tasks: List[str] = field(default_factory=list)
     completed_tasks: List[str] = field(default_factory=list)
+    # reference parity: per-node MRU recency window, written on every
+    # assignment (reference schedulers.py:29,99 — the reference never reads
+    # it back, and neither do our policies, which track recency under the
+    # MRU logical clock; the state exists for inspection parity)
+    last_used_params: deque = field(
+        default_factory=lambda: deque(maxlen=10)
+    )
 
     def __post_init__(self) -> None:
         self.available_memory = self.total_memory
@@ -59,6 +67,7 @@ class DeviceState:
         self.cached_params.clear()
         self.running_tasks.clear()
         self.completed_tasks.clear()
+        self.last_used_params.clear()
 
     @property
     def used_memory(self) -> float:

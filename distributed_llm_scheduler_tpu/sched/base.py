@@ -118,6 +118,9 @@ class BaseScheduler:
                 node.available_memory -= run.graph.param_size_gb(p)
                 run.param_locations.setdefault(p, set()).add(node.node_id)
         node.available_memory -= task.memory_required
+        # recency window, name order (reference schedulers.py:99 extends
+        # with an unordered set; sorted here for determinism)
+        node.last_used_params.extend(run.sorted_params(task))
         task.assigned_node = node.node_id
         task.status = TaskStatus.ASSIGNED
         node.running_tasks.append(task.task_id)

@@ -8,8 +8,10 @@ dataclasses, SURVEY.md §1 wart) and from *timestamped* schedules produced
 by a backend, not hand-written ones (the reference's Gantt scales durations
 by node speed because it has no real timings, ``visu.py:206-248``).
 
-Non-interactive by default: figures save to files (Agg); no ``plt.show()``
-menu loops.
+Non-interactive by default: figures save to files (Agg).  The reference's
+interactive menu loop (``visu.py:294-339``) is replaced by an opt-in
+``show=True`` / CLI ``--show``, which opens the rendered figure in a
+window on display-capable machines after saving it.
 """
 
 from __future__ import annotations
@@ -26,10 +28,11 @@ def _savefig(fig, path: str) -> None:
     fig.savefig(path, dpi=120)
 
 
-def _plt():
+def _plt(show: bool = False):
     import matplotlib
 
-    matplotlib.use("Agg")
+    if not show:
+        matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
     return plt
@@ -55,10 +58,11 @@ def visualize_dag(
     path: str = "dag.png",
     detailed: bool = False,
     max_labels: int = 60,
+    show: bool = False,
 ) -> str:
     """Render the DAG.  ``detailed`` colors nodes by activation memory and
     sizes them by compute time (reference visu.py:122-204)."""
-    plt = _plt()
+    plt = _plt(show)
     pos = _layout(graph)
     fig, ax = plt.subplots(
         figsize=(max(8, len(set(x for x, _ in pos.values())) * 0.9), 8)
@@ -98,6 +102,8 @@ def visualize_dag(
     ax.set_yticks([])
     fig.tight_layout()
     _savefig(fig, path)
+    if show:
+        plt.show()
     plt.close(fig)
     return path
 
@@ -106,6 +112,7 @@ def visualize_schedule(
     schedule: Schedule,
     path: str = "schedule.png",
     title: Optional[str] = None,
+    show: bool = False,
 ) -> str:
     """Gantt chart from a timestamped schedule (run a backend first to fill
     ``schedule.timings``; reference analog visu.py:206-248)."""
@@ -114,10 +121,10 @@ def visualize_schedule(
             "schedule has no timings; execute it on a backend first "
             "(SimulatedBackend.execute or DeviceBackend profile mode)"
         )
-    plt = _plt()
+    plt = _plt(show)
     nodes = sorted(schedule.per_node)
     ypos = {n: i for i, n in enumerate(nodes)}
-    cmap = _plt().get_cmap("tab20")
+    cmap = plt.get_cmap("tab20")
 
     fig, ax = plt.subplots(figsize=(12, 1.2 + 0.6 * len(nodes)))
     groups = {}
@@ -139,5 +146,7 @@ def visualize_schedule(
     ax.set_title(title or f"{schedule.policy}: makespan {schedule.makespan:.4f}s")
     fig.tight_layout()
     _savefig(fig, path)
+    if show:
+        plt.show()
     plt.close(fig)
     return path

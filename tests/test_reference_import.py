@@ -1,0 +1,86 @@
+"""Importing reference-format pickled DAG artifacts (.pkl interchange)."""
+
+import io
+import pickle
+import sys
+import types
+
+import pytest
+
+from distributed_llm_scheduler_tpu import get_scheduler, Cluster
+from distributed_llm_scheduler_tpu.frontend.reference_import import (
+    load_reference_pickle,
+)
+
+
+def make_reference_pickle() -> bytes:
+    """Build a byte-identical analog of the reference's gpt2_dag.pkl: a
+    pickled list of ``schedulers.Task`` instances.  The fake module is
+    registered only while pickling and removed afterwards, proving the
+    loader needs no reference code importable."""
+    mod = types.ModuleType("schedulers")
+
+    class Task:
+        def __init__(self, task_id, memory_required, compute_time,
+                     dependencies=None, params_needed=None):
+            self.id = task_id
+            self.memory_required = memory_required
+            self.compute_time = compute_time
+            self.dependencies = dependencies or []
+            self.params_needed = params_needed or set()
+            self.completed = False
+            self.assigned_node = None
+
+    Task.__module__ = "schedulers"
+    Task.__qualname__ = "Task"
+    mod.Task = Task
+    sys.modules["schedulers"] = mod
+    try:
+        tasks = [
+            Task("t1", 1.0, 2.0, [], {"p1"}),
+            Task("t2", 1.5, 3.0, ["t1"], {"p2"}),
+            Task("t3", 0.8, 1.5, ["t1"], {"p1", "p3"}),
+            Task("t4", 1.2, 2.5, ["t2", "t3"], {"p2", "p4"}),
+        ]
+        tasks[0].completed = True  # stale scheduling state must be dropped
+        tasks[0].assigned_node = "node_0"
+        return pickle.dumps(tasks)
+    finally:
+        del sys.modules["schedulers"]
+
+
+def test_loads_without_reference_module():
+    data = make_reference_pickle()
+    assert "schedulers" not in sys.modules
+    graph = load_reference_pickle(data)
+    assert len(graph) == 4
+    assert graph["t4"].dependencies == ["t2", "t3"]
+    assert graph["t3"].params_needed == {"p1", "p3"}
+    # reference's 0.5 GB/param default carries over
+    assert graph.param_size_gb("p1") == 0.5
+
+
+def test_imported_graph_schedules():
+    graph = load_reference_pickle(make_reference_pickle())
+    cluster = Cluster.uniform(2, 4.0)
+    s = get_scheduler("mru").schedule(graph, cluster)
+    assert len(s.completed) == 4 and not s.failed
+
+
+def test_accepts_path_and_fileobj(tmp_path):
+    data = make_reference_pickle()
+    p = tmp_path / "gpt2_dag.pkl"
+    p.write_bytes(data)
+    assert len(load_reference_pickle(str(p))) == 4
+    assert len(load_reference_pickle(io.BytesIO(data))) == 4
+
+
+def test_rejects_arbitrary_globals():
+    evil = pickle.dumps(print)  # builtins.print is not on the allowlist
+    with pytest.raises(pickle.UnpicklingError, match="refusing"):
+        load_reference_pickle(evil)
+
+
+def test_rejects_non_list():
+    with pytest.raises(ValueError, match="pickled list"):
+        load_reference_pickle(pickle.dumps({"not": "a list"}))
