@@ -22,7 +22,9 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <queue>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -702,6 +704,8 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
   std::vector<int32_t> stage_of_group(n_groups, -1);
   std::vector<int32_t> remaining;
   for (int gi = 0; gi < n_groups; ++gi) remaining.push_back(gi);
+  std::vector<int32_t> parked_placed;
+  bool tail_parked = false;
 
   if (n_groups > n_dev) {
     // park root-bearing groups, largest params first (stable ties)
@@ -726,6 +730,7 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
           reserved[d] += pg;
           remaining.erase(
               std::find(remaining.begin(), remaining.end(), gi));
+          parked_placed.push_back(gi);
           break;
         }
       }
@@ -754,12 +759,15 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
           stage_of_group[ti] = tied_dev;
           reserved[tied_dev] += extra;
           remaining.pop_back();
+          tail_parked = true;
         }
       }
     }
   }
 
-  // contiguous-stage DP over remaining groups (plan_stages)
+  // contiguous-stage DP over remaining groups (plan_stages): lexicographic
+  // (bottleneck stage cost, stages at that bottleneck), stage cost =
+  // max(compute, param-load time) — mirrors sched/pipeline.py exactly
   int n = (int)remaining.size();
   if (n > 0) {
     int kmax = std::min(n, n_dev);
@@ -767,11 +775,16 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
     for (int i = 0; i < n; ++i)
       prefix[i + 1] = prefix[i] + compute[remaining[i]];
     const double INF = 1e300;
-    std::vector<std::vector<double>> best(
-        n + 1, std::vector<double>(kmax + 1, INF));
+    // host rate: <=0 means "free" (Python: None -> inf -> load time 0)
+    double host = link3[0] > 0
+                      ? link3[0]
+                      : std::numeric_limits<double>::infinity();
+    using Cost = std::pair<double, int32_t>;
+    std::vector<std::vector<Cost>> best(
+        n + 1, std::vector<Cost>(kmax + 1, {INF, 0}));
     std::vector<std::vector<int32_t>> choice(
         n + 1, std::vector<int32_t>(kmax + 1, -1));
-    best[0][0] = 0.0;
+    best[0][0] = {0.0, 0};
     std::vector<uint8_t> inparams(g.n_params, 0);
     for (int s = 1; s <= kmax; ++s) {
       double cap = g.node_mem[s - 1] - reserved[s - 1];
@@ -786,8 +799,16 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
             }
           act = std::max(act, activ[remaining[i]]);
           if (pg + act > cap + 1e-9) break;
-          if (best[i][s - 1] >= INF) continue;
-          double cand = std::max(best[i][s - 1], prefix[j] - prefix[i]);
+          if (best[i][s - 1].first >= INF) continue;
+          double cost = std::max(prefix[j] - prefix[i], pg / host);
+          Cost cand;
+          if (cost > best[i][s - 1].first) {
+            cand = {cost, 1};
+          } else if (cost == best[i][s - 1].first) {
+            cand = {best[i][s - 1].first, best[i][s - 1].second + 1};
+          } else {
+            cand = best[i][s - 1];
+          }
           if (cand < best[j][s]) {
             best[j][s] = cand;
             choice[j][s] = i;
@@ -797,7 +818,8 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
     }
     int s_best = -1;
     for (int s = 1; s <= kmax; ++s)
-      if (best[n][s] < INF && (s_best < 0 || best[n][s] < best[n][s_best]))
+      if (best[n][s].first < INF &&
+          (s_best < 0 || best[n][s] < best[n][s_best]))
         s_best = s;
     if (s_best > 0) {
       std::vector<int32_t> bounds(s_best + 1, 0);
@@ -810,6 +832,71 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
       for (int s = 0; s < s_best; ++s)
         for (int i = bounds[s]; i < bounds[s + 1]; ++i)
           stage_of_group[remaining[i]] = s;
+      // load-aware repack of parked groups (sched/pipeline.py
+      // _rebalance_parked): greedily move them onto devices minimizing
+      // the resulting param-union load, adopt only on strict improvement
+      if (!parked_placed.empty() && !tail_parked) {
+        std::vector<std::vector<uint8_t>> base(
+            n_dev, std::vector<uint8_t>(g.n_params, 0));
+        std::vector<double> bact(n_dev, 0.0);
+        std::vector<uint8_t> is_parked(n_groups, 0);
+        for (int gi : parked_placed) is_parked[gi] = 1;
+        for (int gi = 0; gi < n_groups; ++gi) {
+          if (is_parked[gi] || stage_of_group[gi] < 0) continue;
+          int d = stage_of_group[gi];
+          for (int p : gparams[gi]) base[d][p] = 1;
+          bact[d] = std::max(bact[d], activ[gi]);
+        }
+        auto union_gb = [&](const std::vector<uint8_t>& m) {
+          double sum = 0.0;  // ascending id == sorted-name order (parity)
+          for (int p = 0; p < g.n_params; ++p)
+            if (m[p]) sum += g.param_gb[p];
+          return sum;
+        };
+        auto max_load = [&](const std::vector<int32_t>& assign) {
+          std::vector<std::vector<uint8_t>> u = base;
+          for (int gi : parked_placed)
+            for (int p : gparams[gi]) u[assign[gi]][p] = 1;
+          double m = 0.0;
+          for (int d = 0; d < n_dev; ++d) m = std::max(m, union_gb(u[d]));
+          return m;
+        };
+        std::vector<int32_t> orig(n_groups, -1), repack(n_groups, -1);
+        for (int gi : parked_placed) orig[gi] = stage_of_group[gi];
+        std::vector<int32_t> order2 = parked_placed;
+        std::sort(order2.begin(), order2.end(), [&](int a, int b) {
+          if (pg_of[a] != pg_of[b]) return pg_of[a] > pg_of[b];
+          return a < b;  // Python's explicit (.., gi) tie-break
+        });
+        std::vector<std::vector<uint8_t>> acc = base;
+        std::vector<double> aact = bact;
+        bool ok = true;
+        for (int gi : order2) {
+          int best_d = -1;
+          double best_lg = 0.0;
+          for (int d = 0; d < n_dev; ++d) {
+            std::vector<uint8_t> u = acc[d];
+            for (int p : gparams[gi]) u[p] = 1;
+            double lg = union_gb(u);
+            if (lg + std::max(aact[d], activ[gi]) > g.node_mem[d] + 1e-9)
+              continue;
+            if (best_d < 0 || lg < best_lg) {
+              best_d = d;
+              best_lg = lg;
+            }
+          }
+          if (best_d < 0) {
+            ok = false;  // can't fit somewhere: keep the original parking
+            break;
+          }
+          repack[gi] = best_d;
+          for (int p : gparams[gi]) acc[best_d][p] = 1;
+          aact[best_d] = std::max(aact[best_d], activ[gi]);
+        }
+        if (ok && max_load(repack) < max_load(orig) - 1e-12) {
+          for (int gi : parked_placed) stage_of_group[gi] = repack[gi];
+        }
+      }
     } else {
       // greedy sequential fill with reserved-aware budgets
       int dev = 0;

@@ -11,9 +11,13 @@ Here pipeline placement is a first-class policy:
    share groups, so one stage serves every microbatch (1F1B-style overlap
    then emerges in the replay/backend from task-level dependencies);
 2. groups are partitioned into ``min(n_devices, n_groups)`` **contiguous**
-   stages by a linear-partition DP minimizing the max per-stage compute
-   time, subject to per-stage memory feasibility (stage param union + max
-   task activation must fit the stage's device);
+   stages by a linear-partition DP minimizing the lexicographic
+   (bottleneck stage cost, number of stages at that bottleneck), where a
+   stage costs ``max(compute, param-load time)`` — loads overlap compute
+   under the prefetch model, and the count tie-break leaves light stages
+   free for parked root groups (re-packed onto them afterwards) — subject
+   to per-stage memory feasibility (stage param union + max task
+   activation must fit the stage's device);
 3. stage *i* is pinned to device *i*; tasks are assigned in topo order.
 
 Contiguity is what makes this a pipeline: every cross-stage edge flows
@@ -83,26 +87,38 @@ class PipelineStageScheduler(BaseScheduler):
         covers groups [bounds[s], bounds[s+1])) — or None if no feasible
         partition.
 
-        DP over (groups consumed, stages used) minimizing the bottleneck
-        stage compute; memory feasibility is checked against the actual
-        device each stage lands on (minus any per-device ``reserved`` GB held
-        by parked groups), so heterogeneous HBM budgets work.
+        DP over (groups consumed, stages used) minimizing the lexicographic
+        (bottleneck stage cost, count of stages at that bottleneck), stage
+        cost = ``max(compute, param-load time)``; memory feasibility is
+        checked against the actual device each stage lands on (minus any
+        per-device ``reserved`` GB held by parked groups), so heterogeneous
+        HBM budgets work.
         """
         groups, compute, activ, gparams = stats or _group_stats(graph)
         gsorted = [sorted(ps) for ps in gparams]  # name order, sorted ONCE
         n = len(groups)
         k = self.n_stages or min(len(devices), n)
         k = min(k, n, len(devices))
+        # host-link rate converts a stage's param bytes into load time; the
+        # stage's steady-state cost is max(compute, load) because parameter
+        # DMA overlaps compute under the prefetch model (backends/sim.py)
+        host = self.link.param_load_gbps or _INF
 
         prefix = [0.0]
         for c in compute:
             prefix.append(prefix[-1] + c)
 
-        # best[j][s] = minimal bottleneck compute covering first j groups
-        # with s stages; choice[j][s] = start index of stage s
-        best = [[_INF] * (k + 1) for _ in range(n + 1)]
+        # best[j][s] = lexicographic (bottleneck stage cost, number of
+        # stages at that bottleneck) covering first j groups with s stages;
+        # choice[j][s] = start index of stage s.  The count tie-break is
+        # what creates room for the parked-group repack: among equal-
+        # bottleneck partitions it prefers the one with the FEWEST heavy
+        # stages, leaving light stages for parked weights (folding load
+        # into a summed stage cost over-weights it — measured r1; the
+        # max() form with tie-break is the overlap-faithful version)
+        best = [[(_INF, 0)] * (k + 1) for _ in range(n + 1)]
         choice = [[-1] * (k + 1) for _ in range(n + 1)]
-        best[0][0] = 0.0
+        best[0][0] = (0.0, 0)
         for s in range(1, k + 1):
             cap = devices[s - 1].total_memory
             if reserved is not None:
@@ -123,18 +139,21 @@ class PipelineStageScheduler(BaseScheduler):
                     act = max(act, activ[i])
                     if pg + act > cap + 1e-9:
                         break
-                    if best[i][s - 1] == _INF:
+                    prev_b, prev_c = best[i][s - 1]
+                    if prev_b == _INF:
                         continue
-                    # bottleneck metric is stage COMPUTE only: weights load
-                    # once and overlap the pipeline (measured: folding load
-                    # time into the stage cost over-weights it and degrades
-                    # the replayed makespan)
-                    cand = max(best[i][s - 1], prefix[j] - prefix[i])
+                    cost = max(prefix[j] - prefix[i], pg / host)
+                    if cost > prev_b:
+                        cand = (cost, 1)
+                    elif cost == prev_b:
+                        cand = (prev_b, prev_c + 1)
+                    else:
+                        cand = (prev_b, prev_c)
                     if cand < best[j][s]:
                         best[j][s] = cand
                         choice[j][s] = i
         # allow fewer stages than devices (tiny graphs / huge devices)
-        feas = [s for s in range(1, k + 1) if best[n][s] < _INF]
+        feas = [s for s in range(1, k + 1) if best[n][s][0] < _INF]
         if not feas:
             return None
         s = min(feas, key=lambda s: best[n][s])
@@ -145,6 +164,74 @@ class PipelineStageScheduler(BaseScheduler):
             j = choice[j][t]
             bounds[t - 1] = j
         return bounds
+
+    # -- parked-group rebalancing -----------------------------------------
+    def _rebalance_parked(
+        self,
+        graph: TaskGraph,
+        devices: List[DeviceState],
+        all_groups: List[str],
+        all_gparams: List[Set[str]],
+        all_activ: List[float],
+        parked: List[int],
+        stage_of: Dict[str, int],
+    ) -> None:
+        """Re-pack parked root groups onto the lightest stages.
+
+        Parking runs *before* the stage partition exists, one group per
+        least-reserved device — so a parked group can land on a device
+        that then also draws a heavy stage.  In host-link-bound regimes
+        (the measured TPU calibration: 1.55 GB/s host leg) the makespan
+        floor is the heaviest device's param bytes, so once the DP has
+        fixed stages, parked groups are greedily re-packed (largest
+        first) onto the device minimizing the resulting param-union
+        load.  The repack is adopted only if it strictly lowers the
+        bottleneck load; all arithmetic runs in sorted-name order so the
+        native engine twin reproduces it bit-for-bit.  Measured on the
+        flagship bench graph: -11% replayed makespan vs park-first.
+        """
+        n_dev = len(devices)
+        parked_set = set(parked)
+        base_params: List[Set[str]] = [set() for _ in range(n_dev)]
+        base_act = [0.0] * n_dev
+        for gi, gname in enumerate(all_groups):
+            if gi in parked_set or gname not in stage_of:
+                continue
+            d = stage_of[gname]
+            base_params[d] |= all_gparams[gi]
+            base_act[d] = max(base_act[d], all_activ[gi])
+
+        def union_gb(names: Set[str]) -> float:
+            return sum(graph.param_size_gb(p) for p in sorted(names))
+
+        def max_load(assign: Dict[int, int]) -> float:
+            params = [set(s) for s in base_params]
+            for gi, d in assign.items():
+                params[d] |= all_gparams[gi]
+            return max(union_gb(s) for s in params)
+
+        orig = {gi: stage_of[all_groups[gi]] for gi in parked}
+        order = sorted(parked, key=lambda gi: (-union_gb(all_gparams[gi]), gi))
+        params = [set(s) for s in base_params]
+        act = list(base_act)
+        repack: Dict[int, int] = {}
+        for gi in order:
+            best_d, best_load = None, None
+            for d in range(n_dev):
+                names = params[d] | all_gparams[gi]
+                lg = union_gb(names)
+                if lg + max(act[d], all_activ[gi]) > devices[d].total_memory + 1e-9:
+                    continue
+                if best_load is None or lg < best_load:
+                    best_d, best_load = d, lg
+            if best_d is None:
+                return  # can't fit somewhere: keep the original parking
+            repack[gi] = best_d
+            params[best_d] |= all_gparams[gi]
+            act[best_d] = max(act[best_d], all_activ[gi])
+        if max_load(repack) < max_load(orig) - 1e-12:
+            for gi, d in repack.items():
+                stage_of[all_groups[gi]] = d
 
     # -- policy ------------------------------------------------------------
     def run_policy(self, run: SchedulerRun) -> None:
@@ -185,6 +272,8 @@ class PipelineStageScheduler(BaseScheduler):
             return False
 
         remaining = list(range(len(all_groups)))
+        parked_placed: List[int] = []
+        tail_parked = False
         if len(all_groups) > n_dev:  # tiny graphs: plain contiguous stages
             parked = [i for i in remaining if is_root_group[all_groups[i]]]
             for gi in sorted(
@@ -195,6 +284,7 @@ class PipelineStageScheduler(BaseScheduler):
             ):
                 if park(gi):
                     remaining.remove(gi)
+                    parked_placed.append(gi)
 
             # Weight-tied tail (tied embedding/LM-head, reference
             # test_gpt2.py:160-166): co-locate the last group with the parked
@@ -230,6 +320,7 @@ class PipelineStageScheduler(BaseScheduler):
                         stage_of[all_groups[ti]] = tied_dev
                         reserved[tied_dev] += extra
                         remaining.remove(ti)
+                        tail_parked = True
 
         stats = (
             [all_groups[i] for i in remaining],
@@ -244,6 +335,14 @@ class PipelineStageScheduler(BaseScheduler):
             for s in range(len(bounds) - 1):
                 for i in range(bounds[s], bounds[s + 1]):
                     stage_of[groups[i]] = s
+            # load-aware repack of the parked groups now that stage loads
+            # are known (skipped when the weight-tied tail was co-located:
+            # moving its shard would break the tie locality it bought)
+            if parked_placed and not tail_parked:
+                self._rebalance_parked(
+                    graph, devices, all_groups, all_gparams, all_activ,
+                    parked_placed, stage_of,
+                )
         else:
             # greedy sequential fill: walk groups in order, advancing to the
             # next device when the current one can't also hold this group

@@ -1,0 +1,128 @@
+"""Load-aware pipeline stage partitioning + parked-group rebalance.
+
+The flagship bench regime is host-link-bound (measured TPU calibration:
+~1.5 GB/s host leg), where the makespan floor is the heaviest device's
+param bytes.  Two mechanisms keep that bottleneck low, both pinned here:
+
+1. the stage DP's lexicographic cost (bottleneck stage cost with
+   max(compute, load), then the COUNT of bottleneck stages) — among
+   equal-bottleneck partitions it leaves as many light stages as possible;
+2. the parked-group repack, which moves root-bearing groups (vocab shards)
+   onto those light stages once the partition is known.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, DeviceState, Task, TaskGraph
+from distributed_llm_scheduler_tpu.backends.sim import LinkModel, SimulatedBackend
+from distributed_llm_scheduler_tpu.sched.pipeline import PipelineStageScheduler
+
+GB = 1024**3
+
+
+def flagship_shaped_graph(n_layers=6, n_shards=4, mb=2):
+    """Miniature of the bench graph: parked vocab-shard root groups feeding
+    a combine, then a layer chain per microbatch sharing layer weights."""
+    tasks = []
+    combines = []
+    for m in range(mb):
+        shard_ids = []
+        for k in range(n_shards):
+            tid = f"mb{m}_shard_{k}"
+            tasks.append(Task(
+                tid, 0.01, 1e-4, [], {f"S{k}"},
+                param_bytes={f"S{k}": int(0.9 * GB)}, group=f"shard_{k}",
+            ))
+            shard_ids.append(tid)
+        cid = f"mb{m}_combine"
+        tasks.append(Task(cid, 0.01, 1e-4, shard_ids, set(), group="embed"))
+        prev = cid
+        for i in range(n_layers):
+            tid = f"mb{m}_layer_{i}"
+            tasks.append(Task(
+                tid, 0.01, 1e-3, [prev], {f"L{i}"},
+                param_bytes={f"L{i}": int(1.3 * GB)}, group=f"layer_{i}",
+            ))
+            prev = tid
+        combines.append(prev)
+    tasks.append(Task("out", 0.01, 1e-4, combines, set(), group="head"))
+    return TaskGraph(tasks, name="mini_flagship").freeze()
+
+
+def per_device_load(graph, schedule):
+    loads = {}
+    for nid, tids in schedule.per_node.items():
+        seen = set()
+        for t in tids:
+            seen |= graph[t].params_needed
+        loads[nid] = sum(graph.param_size_gb(p) for p in seen)
+    return loads
+
+
+def host_bound_link():
+    # 1 GB/s host leg: loads dominate (the measured-TPU regime), ICI fast
+    return LinkModel(param_load_gbps=1.0, interconnect_gbps=1000.0,
+                     latency_s=0.0)
+
+
+def test_parked_groups_pack_onto_light_stages():
+    graph = flagship_shaped_graph()
+    cluster = Cluster.uniform(4, 100.0)
+    s = PipelineStageScheduler(link=host_bound_link()).schedule(graph, cluster)
+    assert not s.failed
+    loads = per_device_load(graph, s)
+    # 6 layers x 1.3 + 4 shards x 0.9 = 11.4 GB over 4 devices; perfect
+    # split is 2.85.  Park-first + compute-only DP bottlenecks at >= 3.5
+    # (2 layers + a shard); the load-aware partition + repack must land
+    # every device at most 2 layers XOR (1 layer + 2 shards) = 3.1.
+    assert max(loads.values()) <= 3.1 + 1e-6, loads
+    # and the replay reflects it: makespan within 25% of the load floor
+    r = SimulatedBackend(fidelity="full", link=host_bound_link()).execute(
+        graph, cluster, s
+    )
+    assert r.makespan <= max(loads.values()) * 1.25
+
+
+def test_rebalance_not_adopted_when_no_gain():
+    """One parked group, one device clearly lightest: parking already put
+    it there, so the repack must keep placement (and determinism)."""
+    graph = flagship_shaped_graph(n_layers=2, n_shards=1, mb=1)
+    cluster = Cluster.uniform(3, 100.0)
+    sched = PipelineStageScheduler(link=host_bound_link())
+    s1 = sched.schedule(graph, cluster)
+    cluster2 = Cluster.uniform(3, 100.0)
+    s2 = PipelineStageScheduler(link=host_bound_link()).schedule(graph, cluster2)
+    assert s1.per_node == s2.per_node  # deterministic
+    assert not s1.failed
+
+
+def test_memory_pressure_keeps_feasibility():
+    """Tight budgets: the repack may never move a group onto a device it
+    doesn't fit; schedule completes under the same caps as before."""
+    graph = flagship_shaped_graph(n_layers=4, n_shards=4, mb=1)
+    # 4 x 1.3 + 4 x 0.9 = 8.8 GB; caps chosen so ~2.4 GB fits per device
+    cluster = Cluster.uniform(4, 2.7)
+    s = PipelineStageScheduler(link=host_bound_link()).schedule(graph, cluster)
+    assert not s.failed  # caps honored AND everything placed
+    loads = per_device_load(graph, s)
+    for nid, gb in loads.items():
+        assert gb <= 2.7 + 1e-6, (nid, gb)
+
+
+def test_compute_bound_regime_unchanged_quality():
+    """With a fast host link the old compute-balanced behavior must not
+    degrade: bottleneck stage compute stays minimal."""
+    graph = flagship_shaped_graph()
+    cluster = Cluster.uniform(4, 100.0)
+    link = LinkModel(param_load_gbps=10000.0, interconnect_gbps=10000.0,
+                     latency_s=0.0)
+    s = PipelineStageScheduler(link=link).schedule(graph, cluster)
+    assert not s.failed
+    # 6 equal layers on 4 devices: no device may hold 3+ layer groups
+    for nid, tids in s.per_node.items():
+        layer_groups = {
+            graph[t].group for t in tids
+            if (graph[t].group or "").startswith("layer_")
+        }
+        assert len(layer_groups) <= 2, (nid, layer_groups)
