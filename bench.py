@@ -151,6 +151,7 @@ def measure(
         pick_best,
     )
     from distributed_llm_scheduler_tpu.sched.heft import HEFTScheduler
+    from distributed_llm_scheduler_tpu.sched.pack import GroupPackScheduler
     from distributed_llm_scheduler_tpu.sched.pipeline import PipelineStageScheduler
     from distributed_llm_scheduler_tpu.sched.policies import ALL_SCHEDULERS
 
@@ -218,11 +219,13 @@ def measure(
     makespans = {}
     schedules = {}
     for name in sorted(ALL_SCHEDULERS):
-        # HEFT/pipeline optimize the replay's objective: same link model
+        # link-aware policies optimize the replay's objective: same link
         if name == "heft":
             sched = HEFTScheduler(link=link)
         elif name == "pipeline":
             sched = PipelineStageScheduler(link=link)
+        elif name == "pack":
+            sched = GroupPackScheduler(link=link)
         else:
             sched = get_scheduler(name)
         s = sched.schedule(graph, cluster)

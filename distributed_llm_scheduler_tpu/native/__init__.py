@@ -38,6 +38,7 @@ POLICY_IDS = {
     "mru": 4,
     "heft": 5,
     "pipeline": 6,
+    "pack": 7,
 }
 
 

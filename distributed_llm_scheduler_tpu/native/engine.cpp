@@ -671,34 +671,54 @@ EventOrder event_order(const Graph& g, const Run& run,
   return out;
 }
 
+// Group statistics in first-appearance (== group id) order, shared by the
+// pipeline and pack policies (mirrors sched/pipeline.py _group_stats).
+struct GroupStats {
+  int n_groups = 0;
+  std::vector<double> compute, activ, pg_of;
+  std::vector<std::vector<int32_t>> gparams;  // sorted, unique
+  std::vector<uint8_t> has_root;
+};
+
+GroupStats group_stats(const Graph& g, const int32_t* group_ids) {
+  GroupStats st;
+  for (int t = 0; t < g.n_tasks; ++t)
+    st.n_groups = std::max(st.n_groups, group_ids[t] + 1);
+  st.compute.assign(st.n_groups, 0.0);
+  st.activ.assign(st.n_groups, 0.0);
+  st.gparams.resize(st.n_groups);
+  st.has_root.assign(st.n_groups, 0);
+  for (int t = 0; t < g.n_tasks; ++t) {  // insertion order, like Python
+    int gi = group_ids[t];
+    st.compute[gi] += g.task_time[t];
+    st.activ[gi] = std::max(st.activ[gi], g.task_mem[t]);
+    if (g.ndeps(t) == 0) st.has_root[gi] = 1;
+  }
+  for (int t = 0; t < g.n_tasks; ++t)  // one pass, not per-group rescans
+    for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
+      st.gparams[group_ids[t]].push_back(g.par_ids[k]);
+  st.pg_of.assign(st.n_groups, 0.0);
+  for (int gi = 0; gi < st.n_groups; ++gi) {
+    std::vector<int32_t>& ps = st.gparams[gi];
+    std::sort(ps.begin(), ps.end());
+    ps.erase(std::unique(ps.begin(), ps.end()), ps.end());
+    for (int p : ps) st.pg_of[gi] += g.param_gb[p];  // asc == name order
+  }
+  return st;
+}
+
 void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
   const Graph& g = run.g;
   int n_dev = g.n_nodes;
   std::vector<int32_t> topo = g.toposort();
 
-  // group stats in first-appearance (== group id) order
-  int n_groups = 0;
-  for (int t = 0; t < g.n_tasks; ++t)
-    n_groups = std::max(n_groups, group_ids[t] + 1);
-  std::vector<double> compute(n_groups, 0.0), activ(n_groups, 0.0);
-  std::vector<std::vector<int32_t>> gparams(n_groups);  // sorted, unique
-  std::vector<uint8_t> has_root(n_groups, 0);
-  for (int t = 0; t < g.n_tasks; ++t) {  // insertion order, like Python
-    int gi = group_ids[t];
-    compute[gi] += g.task_time[t];
-    activ[gi] = std::max(activ[gi], g.task_mem[t]);
-    if (g.ndeps(t) == 0) has_root[gi] = 1;
-  }
-  for (int t = 0; t < g.n_tasks; ++t)  // one pass, not per-group rescans
-    for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
-      gparams[group_ids[t]].push_back(g.par_ids[k]);
-  std::vector<double> pg_of(n_groups, 0.0);
-  for (int gi = 0; gi < n_groups; ++gi) {
-    std::vector<int32_t>& ps = gparams[gi];
-    std::sort(ps.begin(), ps.end());
-    ps.erase(std::unique(ps.begin(), ps.end()), ps.end());
-    for (int p : ps) pg_of[gi] += g.param_gb[p];  // ascending == name order
-  }
+  GroupStats st = group_stats(g, group_ids);
+  int n_groups = st.n_groups;
+  std::vector<double>& compute = st.compute;
+  std::vector<double>& activ = st.activ;
+  std::vector<double>& pg_of = st.pg_of;
+  std::vector<std::vector<int32_t>>& gparams = st.gparams;
+  std::vector<uint8_t>& has_root = st.has_root;
 
   std::vector<double> reserved(n_dev, 0.0);
   std::vector<int32_t> stage_of_group(n_groups, -1);
@@ -880,7 +900,9 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
             double lg = union_gb(u);
             if (lg + std::max(aact[d], activ[gi]) > g.node_mem[d] + 1e-9)
               continue;
-            if (best_d < 0 || lg < best_lg) {
+            // ties prefer the LATER device (pipeline.py: lg <= best_load)
+            // so parked loads don't queue ahead of early-stage weights
+            if (best_d < 0 || lg <= best_lg) {
               best_d = d;
               best_lg = lg;
             }
@@ -946,6 +968,74 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
   run.order = std::move(eo.order);
 }
 
+// Group-pack policy (sched/pack.py): non-contiguous LPT packing of groups
+// onto devices by resulting param-union load, then event-ordered execution.
+void run_pack(Run& run, const double* link3, const int32_t* group_ids) {
+  const Graph& g = run.g;
+  int n_dev = g.n_nodes;
+  std::vector<int32_t> topo = g.toposort();
+  GroupStats st = group_stats(g, group_ids);
+
+  std::vector<std::vector<uint8_t>> dev_params(
+      n_dev, std::vector<uint8_t>(g.n_params, 0));
+  std::vector<double> dev_act(n_dev, 0.0);
+  std::vector<int32_t> placed(st.n_groups, -1);
+
+  auto union_gb = [&](const std::vector<uint8_t>& m) {
+    double sum = 0.0;  // ascending id == sorted-name order (parity)
+    for (int p = 0; p < g.n_params; ++p)
+      if (m[p]) sum += g.param_gb[p];
+    return sum;
+  };
+
+  // largest parameter footprint first (LPT), ties by group order
+  std::vector<int32_t> order(st.n_groups);
+  for (int gi = 0; gi < st.n_groups; ++gi) order[gi] = gi;
+  std::sort(order.begin(), order.end(), [&](int a, int b) {
+    if (st.pg_of[a] != st.pg_of[b]) return st.pg_of[a] > st.pg_of[b];
+    return a < b;
+  });
+  for (int gi : order) {
+    int best_d = -1;
+    double best_load = 0.0;
+    for (int d = 0; d < n_dev; ++d) {
+      std::vector<uint8_t> u = dev_params[d];
+      for (int p : st.gparams[gi]) u[p] = 1;
+      double lg = union_gb(u);
+      if (lg + std::max(dev_act[d], st.activ[gi]) > g.node_mem[d] + 1e-9)
+        continue;
+      if (best_d < 0 || lg < best_load) {
+        best_d = d;
+        best_load = lg;
+      }
+    }
+    if (best_d < 0) continue;  // group fits nowhere: its tasks fail below
+    placed[gi] = best_d;
+    for (int p : st.gparams[gi]) dev_params[best_d][p] = 1;
+    dev_act[best_d] = std::max(dev_act[best_d], st.activ[gi]);
+  }
+
+  for (int tid : topo) {
+    if (!run.pending[tid]) continue;
+    bool dep_failed = false;
+    for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k)
+      if (run.failed[g.dep_ids[k]]) dep_failed = true;
+    if (dep_failed) {
+      run.do_fail(tid);
+      continue;
+    }
+    int node = placed[group_ids[tid]];
+    if (node >= 0 && run.can_fit(tid, node)) {
+      run.do_assign(tid, node);
+    } else {
+      run.do_fail(tid);
+    }
+  }
+
+  EventOrder eo = event_order(g, run, topo, link3);
+  run.order = std::move(eo.order);
+}
+
 }  // namespace
 
 extern "C" {
@@ -990,6 +1080,10 @@ int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
     case 6:
       if (group_ids == nullptr) return -2;
       run_pipeline(run, link3, group_ids);
+      break;
+    case 7:
+      if (group_ids == nullptr) return -2;
+      run_pack(run, link3, group_ids);
       break;
     default: return -1;
   }

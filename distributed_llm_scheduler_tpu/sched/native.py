@@ -109,7 +109,7 @@ class NativeScheduler(BaseScheduler):
         link3 = np.asarray(self._link, dtype=np.float64)
 
         group_ids = None
-        if self.policy == "pipeline":
+        if self.policy in ("pipeline", "pack"):
             # group index by first appearance over the TOPO order, matching
             # the Python _group_stats ordering (ungrouped: singleton groups)
             gidx: Dict[str, int] = {}

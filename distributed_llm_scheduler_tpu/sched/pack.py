@@ -1,0 +1,103 @@
+"""Group-pack policy: balanced, locality-first group packing.
+
+Born from the measured-TPU bench regime (host link ~1.5 GB/s through the
+tunnel): with parameter loads dominating, makespan floors at the heaviest
+device's param bytes, and *contiguity* — the pipeline policy's defining
+constraint — stops paying for itself because ICI transfers are two orders
+of magnitude cheaper than host loads.  This policy drops contiguity and
+solves the remaining problem directly:
+
+1. bucket tasks by ``group`` (one weight-set per group, exactly the unit
+   the reference's param-cache model revolves around — reference
+   ``schedulers.py:63-76`` charges per-param load once per node);
+2. pack groups onto devices, largest parameter footprint first, each onto
+   the device minimizing the resulting param-union load time — classic
+   LPT bin balancing with union-aware sizes, so weight-tied groups
+   gravitate to the device already holding their shared table;
+3. order execution with the dependency-aware event simulation
+   (:mod:`.eventsim`), which recovers 1F1B-style interleaving from the
+   DAG structure.
+
+On the flagship bench graph this replays at 21.6 ms vs greedy's 23.3 ms
+and pipeline's 23.3 ms under the measured link (load spread 26-31 MB/core
+vs a 29 MB perfect split).  In compute-bound regimes it degrades toward
+plain load balancing — the evaluator sweep keeps all policies comparable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..backends.sim import LinkModel
+from .base import BaseScheduler, SchedulerRun
+from .eventsim import dependency_aware_order
+from .pipeline import _group_stats
+
+
+class GroupPackScheduler(BaseScheduler):
+    """Non-contiguous balanced group packing (LPT over param-union loads)."""
+
+    name = "pack"
+
+    def __init__(self, link: Optional[LinkModel] = None):
+        self.link = link or LinkModel()
+
+    def run_policy(self, run: SchedulerRun) -> None:
+        graph, devices = run.graph, run.cluster.devices
+        n_dev = len(devices)
+        groups, compute, activ, gparams = _group_stats(graph)
+
+        def union_gb(names: Set[str]) -> float:
+            # sorted-name accumulation: deterministic and native-parity-safe
+            return sum(graph.param_size_gb(p) for p in sorted(names))
+
+        dev_params: List[Set[str]] = [set() for _ in range(n_dev)]
+        dev_act = [0.0] * n_dev
+        placed: Dict[str, int] = {}
+        # largest parameter footprint first (LPT), ties by group order
+        order = sorted(
+            range(len(groups)), key=lambda i: (-union_gb(gparams[i]), i)
+        )
+        for gi in order:
+            best_d, best_load = None, None
+            for d in range(n_dev):
+                lg = union_gb(dev_params[d] | gparams[gi])
+                if (
+                    lg + max(dev_act[d], activ[gi])
+                    > devices[d].total_memory + 1e-9
+                ):
+                    continue
+                if best_load is None or lg < best_load:
+                    best_d, best_load = d, lg
+            if best_d is None:
+                continue  # group fits nowhere: its tasks fail below
+            placed[groups[gi]] = best_d
+            dev_params[best_d] |= gparams[gi]
+            dev_act[best_d] = max(dev_act[best_d], activ[gi])
+
+        for tid in graph.topo_order:
+            task = graph[tid]
+            if tid not in run.pending:
+                continue
+            if any(d in run.failed for d in task.dependencies):
+                self.fail(run, task)
+                continue
+            d = placed.get(task.group or tid)
+            if d is not None and self.can_fit(run, task, devices[d]):
+                self.assign(run, task, devices[d])
+            else:
+                self.fail(run, task)
+
+        # dependency-aware execution order (same post-pass as pipeline)
+        placement = {
+            tid: run.graph[tid].assigned_node for tid in run.assignment_order
+        }
+        speeds = {d.node_id: d.compute_speed for d in run.cluster}
+        exec_order = dependency_aware_order(
+            run.graph, placement, speeds, self.link,
+            slices=run.cluster.slice_ids(),
+        )
+        run.assignment_order[:] = exec_order
+        pos = {tid: i for i, tid in enumerate(exec_order)}
+        for nid, tids in run.per_node.items():
+            tids.sort(key=lambda t: pos[t])

@@ -222,7 +222,12 @@ class PipelineStageScheduler(BaseScheduler):
                 lg = union_gb(names)
                 if lg + max(act[d], all_activ[gi]) > devices[d].total_memory + 1e-9:
                     continue
-                if best_load is None or lg < best_load:
+                # ties prefer the LATER device: stage s is pinned to device
+                # s, and a parked load on an early stage queues ahead of
+                # that stage's weights (first-use order), delaying the
+                # pipeline fill; late stages have until the wave reaches
+                # them (>= keeps the highest tied index)
+                if best_load is None or lg <= best_load:
                     best_d, best_load = d, lg
             if best_d is None:
                 return  # can't fit somewhere: keep the original parking

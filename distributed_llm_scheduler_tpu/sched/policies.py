@@ -240,6 +240,7 @@ class MRUScheduler(BaseScheduler):
 
 
 from .heft import HEFTScheduler  # noqa: E402  (avoids a circular import)
+from .pack import GroupPackScheduler  # noqa: E402
 from .pipeline import PipelineStageScheduler  # noqa: E402
 
 ALL_SCHEDULERS = {
@@ -252,6 +253,7 @@ ALL_SCHEDULERS = {
         MRUScheduler,
         HEFTScheduler,
         PipelineStageScheduler,
+        GroupPackScheduler,
     )
 }
 

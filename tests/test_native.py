@@ -108,3 +108,17 @@ def test_env_upgrade(monkeypatch):
 def test_native_rejects_unknown_policy():
     with pytest.raises(ValueError, match="no native implementation"):
         NativeScheduler("no-such-policy")
+
+
+def test_parity_pipeline_repack_ties():
+    """Regression: the parked-group repack's tie-break (equal param-union
+    loads -> prefer the LATER device) must match between Python and C++.
+    flagship-shaped graph with equal-size shard groups hits exact float
+    ties during the repack (caught diverging in review, round 2)."""
+    from test_pipeline_rebalance import flagship_shaped_graph
+
+    graph = flagship_shaped_graph(n_layers=6, n_shards=2, mb=2)
+    for policy in ("pipeline", "pack"):
+        py = ALL_SCHEDULERS[policy]().schedule(graph, Cluster.uniform(4, 100.0))
+        nat = NativeScheduler(policy).schedule(graph, Cluster.uniform(4, 100.0))
+        assert_same_schedule(py, nat, f"{policy}/repack-ties")
