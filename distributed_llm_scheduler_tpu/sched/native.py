@@ -28,7 +28,7 @@ from .base import BaseScheduler
 
 
 class NativeScheduler(BaseScheduler):
-    """One of the six policies, executed by the native engine."""
+    """One of the eight policies, executed by the native engine."""
 
     def __init__(self, policy: str, link=None):
         from ..native import POLICY_IDS
