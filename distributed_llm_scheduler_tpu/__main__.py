@@ -167,7 +167,9 @@ def cmd_train(args) -> int:
     mcfg = cfg_map.get(args.model, GPT2Config.tiny)()
     axes = factorize_mesh(len(jax.devices()))
     mesh = make_mesh(**axes)
-    train_step, init_state = make_train_step(mcfg, mesh)
+    train_step, init_state = make_train_step(
+        mcfg, mesh, remat=args.remat, scan=args.scan
+    )
     state = init_state(jax.random.PRNGKey(args.seed))
     batch = max(2 * axes["dp"], 2)
     seq = min(args.seq_len, mcfg.n_positions)
@@ -236,6 +238,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("train", help="run sharded training steps")
     _add_common(p)
     p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize transformer blocks in the backward "
+                        "pass (jax.checkpoint): HBM for FLOPs")
+    p.add_argument("--scan", action="store_true",
+                   help="scan over stacked layers (lax.scan): one compiled "
+                        "block regardless of depth")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")

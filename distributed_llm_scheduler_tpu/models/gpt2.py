@@ -180,30 +180,112 @@ def output_projection(x: jax.Array, wte: jax.Array) -> jax.Array:
 
 # -- whole-model forward (fused baseline + correctness oracle) --------------
 
-def forward(
-    params: Dict[str, jax.Array], input_ids: jax.Array, config: GPT2Config
+_BLOCK_KEYS = (
+    "ln1_g", "ln1_b", "attn_qkv_w", "attn_qkv_b", "attn_proj_w",
+    "attn_proj_b", "ln2_g", "ln2_b", "mlp_fc_w", "mlp_fc_b",
+    "mlp_proj_w", "mlp_proj_b",
+)
+
+
+def transformer_block(
+    block_params: Dict[str, jax.Array], x: jax.Array, config: GPT2Config
 ) -> jax.Array:
-    """Full forward pass composing exactly the per-op functions above."""
+    """One layer (pre-LN attention + MLP with residuals), params keyed by
+    the unprefixed ``_BLOCK_KEYS`` names.  The unit of rematerialization
+    and of the scanned forward."""
+    ln1 = layer_norm(x, block_params["ln1_g"], block_params["ln1_b"], config.ln_eps)
+    attn = causal_attention(
+        ln1,
+        block_params["attn_qkv_w"],
+        block_params["attn_qkv_b"],
+        block_params["attn_proj_w"],
+        block_params["attn_proj_b"],
+        config.n_head,
+    )
+    x = residual_add(x, attn)
+    ln2 = layer_norm(x, block_params["ln2_g"], block_params["ln2_b"], config.ln_eps)
+    h = ffn_expand(ln2, block_params["mlp_fc_w"], block_params["mlp_fc_b"])
+    h = ffn_activation(h)
+    h = ffn_contract(h, block_params["mlp_proj_w"], block_params["mlp_proj_b"])
+    return residual_add(x, h)
+
+
+def _select_block(remat: bool):
+    """The layer function both forwards iterate: checkpointed or plain."""
+    if remat:
+        return jax.checkpoint(transformer_block, static_argnums=(2,))
+    return transformer_block
+
+
+def _head(
+    x: jax.Array, params: Dict[str, jax.Array], config: GPT2Config
+) -> jax.Array:
+    """Shared epilogue: final LN + weight-tied output projection."""
+    x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], config.ln_eps)
+    return output_projection(x, params["wte"])
+
+
+def forward(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: GPT2Config,
+    remat: bool = False,
+) -> jax.Array:
+    """Full forward pass composing exactly the per-op functions above.
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint`` so the backward
+    pass recomputes block activations instead of storing them — the
+    standard TPU HBM-for-FLOPs trade for training deep models.
+    """
+    block = _select_block(remat)
     x = embedding(input_ids, params["wte"], params["wpe"])
     for i in range(config.n_layer):
         p = f"h{i}_"
-        ln1 = layer_norm(x, params[p + "ln1_g"], params[p + "ln1_b"], config.ln_eps)
-        attn = causal_attention(
-            ln1,
-            params[p + "attn_qkv_w"],
-            params[p + "attn_qkv_b"],
-            params[p + "attn_proj_w"],
-            params[p + "attn_proj_b"],
-            config.n_head,
+        x = block({k: params[p + k] for k in _BLOCK_KEYS}, x, config)
+    return _head(x, params, config)
+
+
+# -- scanned forward (stacked layers, one compiled block) --------------------
+
+def stack_layer_params(
+    params: Dict[str, jax.Array], config: GPT2Config
+) -> Dict[str, jax.Array]:
+    """Per-layer ``h{i}_*`` tensors -> stacked ``layers_*`` with a leading
+    layer dim (plus the non-layer params unchanged).  The scanned-forward
+    layout; numbers are identical to the flat layout."""
+    out = {
+        k: v for k, v in params.items() if not k.startswith("h")
+    }
+    for key in _BLOCK_KEYS:
+        out["layers_" + key] = jnp.stack(
+            [params[f"h{i}_{key}"] for i in range(config.n_layer)]
         )
-        x = residual_add(x, attn)
-        ln2 = layer_norm(x, params[p + "ln2_g"], params[p + "ln2_b"], config.ln_eps)
-        h = ffn_expand(ln2, params[p + "mlp_fc_w"], params[p + "mlp_fc_b"])
-        h = ffn_activation(h)
-        h = ffn_contract(h, params[p + "mlp_proj_w"], params[p + "mlp_proj_b"])
-        x = residual_add(x, h)
-    x = layer_norm(x, params["ln_f_g"], params["ln_f_b"], config.ln_eps)
-    return output_projection(x, params["wte"])
+    return out
+
+
+def forward_scan(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: GPT2Config,
+    remat: bool = False,
+) -> jax.Array:
+    """Forward over stacked layer params via ``lax.scan``.
+
+    XLA traces and compiles the transformer block ONCE instead of
+    ``n_layer`` times — the idiomatic TPU formulation for deep models
+    (compile time and program size stay O(1) in depth).  Combine with
+    ``remat=True`` for the standard scan-over-remat-blocks training setup.
+    Matches :func:`forward` numerically (same block math, same order).
+    """
+    block = _select_block(remat)
+    stacked = {k: params["layers_" + k] for k in _BLOCK_KEYS}
+
+    def step(x, layer_params):
+        return block(layer_params, x, config), None
+
+    x = embedding(input_ids, params["wte"], params["wpe"])
+    x, _ = jax.lax.scan(step, x, stacked)
+    return _head(x, params, config)
 
 
 def loss_fn(
@@ -211,9 +293,15 @@ def loss_fn(
     input_ids: jax.Array,
     targets: jax.Array,
     config: GPT2Config,
+    remat: bool = False,
+    scan: bool = False,
 ) -> jax.Array:
-    """Next-token cross-entropy (training-step DAGs and the parallel layer)."""
-    logits = forward(params, input_ids, config)
+    """Next-token cross-entropy (training-step DAGs and the parallel layer).
+
+    ``scan=True`` expects stacked-layer params (:func:`stack_layer_params`)
+    and runs the scanned forward."""
+    fwd = forward_scan if scan else forward
+    logits = fwd(params, input_ids, config, remat=remat)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()

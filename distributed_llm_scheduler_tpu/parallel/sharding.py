@@ -44,6 +44,10 @@ GPT2_PARAM_RULES = [
 
 
 def param_spec(name: str) -> P:
+    # stacked-layer params (models/gpt2.stack_layer_params): the leading
+    # layer dim is never sharded; the per-layer spec shifts right by one
+    if name.startswith("layers_"):
+        return P(None, *param_spec(name[len("layers_"):]))
     for pattern, spec in GPT2_PARAM_RULES:
         if re.search(pattern, name):
             return spec

@@ -36,21 +36,33 @@ def make_train_step(
     mesh: Mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
     seq_parallel: bool = False,
+    remat: bool = False,
+    scan: bool = False,
 ) -> Tuple[Callable[..., Any], Callable[..., TrainState]]:
     """Returns ``(train_step, init_state)``.
 
     ``train_step(state, input_ids, targets) -> (state, loss)`` is jitted
     with donated state; ``init_state(key)`` materializes sharded params and
-    optimizer state on the mesh.
+    optimizer state on the mesh.  ``remat=True`` rematerializes each
+    transformer block in the backward pass (``jax.checkpoint``), trading
+    FLOPs for HBM — the standard way to fit longer sequences/deeper models
+    per core.  ``scan=True`` stacks layer params and scans the block
+    (``lax.scan``) so XLA compiles it once regardless of depth; combine
+    both for the standard scan-over-remat-blocks setup.
     """
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
 
     def loss_fn(params, input_ids, targets):
-        return gpt2.loss_fn(params, input_ids, targets, config)
+        return gpt2.loss_fn(
+            params, input_ids, targets, config, remat=remat, scan=scan
+        )
 
     def init_state(key: Optional[jax.Array] = None) -> TrainState:
         key = key if key is not None else jax.random.PRNGKey(0)
-        params = shard_params(mesh, gpt2.init_params(config, key))
+        params = gpt2.init_params(config, key)
+        if scan:
+            params = gpt2.stack_layer_params(params, config)
+        params = shard_params(mesh, params)
         opt_state = optimizer.init(params)
         return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
 
