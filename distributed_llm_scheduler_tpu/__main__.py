@@ -36,6 +36,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="schedule one fwd+bwd+optimizer step (gpt2* models)")
     p.add_argument("--num-layers", type=int, default=None)
     p.add_argument("--num-nodes", type=int, default=8)
+    p.add_argument("--slices", type=int, default=1,
+                   help=">1: multi-slice topology (nodes split slice-by-"
+                        "slice, DCN charged between slices)")
     p.add_argument("--hbm-gb", type=float, default=14.0)
     p.add_argument("--memory-regime", type=float, default=1.0)
     p.add_argument("--scheduler", default="heft")
@@ -64,15 +67,13 @@ def _replay_backend(cfg):
 
 
 def cmd_schedule(args) -> int:
-    from .sched.policies import get_scheduler
     from .utils.serialization import save_graph, save_schedule
 
     cfg = _config_from(args)
     dag = cfg.build_graph()
     graph = getattr(dag, "graph", dag)
     cluster = cfg.build_cluster()
-    sched = get_scheduler(cfg.scheduler)
-    schedule = sched.schedule(graph, cluster)
+    schedule = cfg.build_scheduler().schedule(graph, cluster)
     if args.validate:
         from .core.validate import validate_schedule
 
@@ -102,6 +103,12 @@ def cmd_sweep(args) -> int:
     from .eval.evaluator import Evaluator
 
     cfg = _config_from(args)
+    if cfg.slices > 1:
+        # silently running a flat-topology sweep would misreport the
+        # DCN-aware config the user asked for
+        print("sweep does not support --slices yet; run `schedule --slices "
+              "N` per policy for multislice numbers", file=sys.stderr)
+        return 2
     ev = Evaluator(
         node_counts=cfg.node_counts,
         memory_regimes=cfg.memory_regimes,
@@ -115,7 +122,6 @@ def cmd_sweep(args) -> int:
 
 def cmd_execute(args) -> int:
     from .backends.device import DeviceBackend
-    from .sched.policies import get_scheduler
 
     cfg = _config_from(args)
     dag = cfg.build_graph()
@@ -124,7 +130,7 @@ def cmd_execute(args) -> int:
               "synthetic graphs have no fns", file=sys.stderr)
         return 2
     cluster = cfg.build_cluster_with_devices()
-    schedule = get_scheduler(cfg.scheduler).schedule(dag.graph, cluster)
+    schedule = cfg.build_scheduler().schedule(dag.graph, cluster)
     backend = DeviceBackend(cluster)
     params = dag.init_params()
     ids = dag.make_inputs()
@@ -134,7 +140,6 @@ def cmd_execute(args) -> int:
 
 
 def cmd_visualize(args) -> int:
-    from .sched.policies import get_scheduler
     from .visu.plots import visualize_dag, visualize_schedule
 
     cfg = _config_from(args)
@@ -145,7 +150,7 @@ def cmd_visualize(args) -> int:
         show=args.show,
     ))
     cluster = cfg.build_cluster()
-    schedule = get_scheduler(cfg.scheduler).schedule(graph, cluster)
+    schedule = cfg.build_scheduler().schedule(graph, cluster)
     _replay_backend(cfg).execute(graph, cluster, schedule)
     print("gantt ->", visualize_schedule(
         schedule, f"{cfg.out_dir}/{graph.name}.{cfg.scheduler}.gantt.png",

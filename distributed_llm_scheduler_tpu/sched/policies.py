@@ -258,24 +258,39 @@ ALL_SCHEDULERS = {
 }
 
 
-def get_scheduler(name: str) -> BaseScheduler:
+def get_scheduler(name: str, link=None) -> BaseScheduler:
     """Policy by name.  ``"native:<policy>"`` selects the C++ engine
     explicitly; ``DLS_NATIVE=1`` upgrades every natively-supported policy
-    transparently (parity-tested: identical schedules, faster wall time)."""
+    transparently (parity-tested: identical schedules, faster wall time).
+
+    ``link`` hands link-aware policies (any whose constructor takes a
+    ``link=`` keyword) the same cost model the replay charges — required
+    for DCN-aware multislice runs.  An explicit ``"native:..."`` request
+    with a tiered link raises (the C ABI is flat-link only); the
+    ``DLS_NATIVE=1`` transparent upgrade instead falls back to the Python
+    policy so the tiered costs are honored.
+    """
+    import inspect
     import os
 
+    from ..backends.sim import TieredLinkModel
+
+    tiered = isinstance(link, TieredLinkModel)
     if name.startswith("native:"):
         from .native import NativeScheduler
 
-        return NativeScheduler(name.split(":", 1)[1])
+        return NativeScheduler(name.split(":", 1)[1], link=link)
     if name not in ALL_SCHEDULERS:
         raise ValueError(
             f"unknown scheduler {name!r}; available: {sorted(ALL_SCHEDULERS)}"
         )
-    if os.environ.get("DLS_NATIVE") == "1":
+    if os.environ.get("DLS_NATIVE") == "1" and not tiered:
         from .. import native as native_mod
         from .native import NativeScheduler
 
         if name in native_mod.POLICY_IDS and native_mod.available():
-            return NativeScheduler(name)
-    return ALL_SCHEDULERS[name]()
+            return NativeScheduler(name, link=link)
+    cls = ALL_SCHEDULERS[name]
+    if link is not None and "link" in inspect.signature(cls.__init__).parameters:
+        return cls(link=link)
+    return cls()

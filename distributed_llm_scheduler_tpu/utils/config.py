@@ -30,6 +30,7 @@ class RunConfig:
     hbm_gb: float = 14.0
     memory_regime: float = 1.0
     use_jax_devices: bool = False  # bind live devices (device backend)
+    slices: int = 1                # >1: multi-slice topology (DCN between)
 
     # scheduling
     scheduler: str = "heft"
@@ -166,14 +167,43 @@ class RunConfig:
 
         if self.use_jax_devices:
             return Cluster.from_jax_devices(hbm_cap_gb=self.hbm_gb)
+        if self.slices > 1:
+            if self.num_nodes % self.slices != 0:
+                raise ValueError(
+                    f"--slices {self.slices} must divide "
+                    f"--num-nodes {self.num_nodes}"
+                )
+            return Cluster.multislice(
+                self.slices,
+                self.num_nodes // self.slices,
+                self.hbm_gb * self.memory_regime,
+            )
         return Cluster.uniform(self.num_nodes, self.hbm_gb * self.memory_regime)
+
+    def build_link(self):
+        """The replay's link model: tiered (ICI/DCN) for multi-slice
+        topologies, flat defaults otherwise."""
+        if self.slices > 1:
+            from ..backends.sim import TieredLinkModel
+
+            return TieredLinkModel()
+        return None  # SimulatedBackend's flat defaults
+
+    def build_scheduler(self):
+        """The configured policy; link-aware policies receive the same
+        link model the replay charges (``get_scheduler`` detects the
+        ``link=`` keyword), so multi-slice runs optimize DCN-aware costs."""
+        from ..sched.policies import get_scheduler
+
+        return get_scheduler(self.scheduler, link=self.build_link())
 
     def build_backend(self):
         from ..backends.sim import SimulatedBackend
 
         if self.backend == "sim":
             return SimulatedBackend(
-                fidelity="full", prefetch_params=self.prefetch_params
+                fidelity="full", prefetch_params=self.prefetch_params,
+                link=self.build_link(),
             )
         if self.backend == "sim-reference":
             return SimulatedBackend(fidelity="reference")

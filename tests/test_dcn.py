@@ -146,3 +146,53 @@ class TestNativeGuard:
 
         with pytest.raises(ValueError, match="flat LinkModel only"):
             NativeScheduler("heft", link=tiered())
+
+
+class TestConfigMultislice:
+    def test_config_builds_multislice_cluster_and_tiered_link(self):
+        from distributed_llm_scheduler_tpu.utils.config import RunConfig
+
+        cfg = RunConfig(num_nodes=8, slices=2, scheduler="pack")
+        cluster = cfg.build_cluster()
+        assert len(cluster) == 8
+        assert sorted(set(cluster.slice_ids().values())) == [0, 1]
+        assert isinstance(cfg.build_link(), TieredLinkModel)
+        sched = cfg.build_scheduler()
+        assert isinstance(sched.link, TieredLinkModel)
+
+    def test_config_single_slice_unchanged(self):
+        from distributed_llm_scheduler_tpu.utils.config import RunConfig
+
+        cfg = RunConfig(num_nodes=4, scheduler="mru")
+        assert cfg.build_link() is None
+        assert set(cfg.build_cluster().slice_ids().values()) == {0}
+
+    def test_config_rejects_indivisible_slices(self):
+        import pytest as _pytest
+
+        from distributed_llm_scheduler_tpu.utils.config import RunConfig
+
+        with _pytest.raises(ValueError, match="must divide"):
+            RunConfig(num_nodes=8, slices=3).build_cluster()
+
+
+class TestGetSchedulerLink:
+    def test_link_passed_to_any_link_aware_policy(self):
+        for name in ("heft", "pipeline", "pack"):
+            s = get_scheduler(name, link=tiered())
+            assert isinstance(s.link, TieredLinkModel), name
+
+    def test_link_ignored_by_link_free_policies(self):
+        s = get_scheduler("mru", link=tiered())
+        assert not hasattr(s, "link")
+
+    def test_explicit_native_with_tiered_link_raises(self):
+        with pytest.raises(ValueError, match="flat LinkModel only"):
+            get_scheduler("native:heft", link=tiered())
+
+    def test_dls_native_upgrade_skipped_for_tiered(self, monkeypatch):
+        from distributed_llm_scheduler_tpu.sched.heft import HEFTScheduler
+
+        monkeypatch.setenv("DLS_NATIVE", "1")
+        s = get_scheduler("heft", link=tiered())
+        assert isinstance(s, HEFTScheduler)  # Python, honoring DCN costs
