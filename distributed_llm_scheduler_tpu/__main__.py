@@ -124,6 +124,14 @@ def cmd_execute(args) -> int:
     from .backends.device import DeviceBackend
 
     cfg = _config_from(args)
+    if cfg.slices > 1:
+        # live clusters carry their REAL slice topology (from_jax_devices
+        # reads device.slice_index); an artificial --slices would silently
+        # not apply, like the sweep guard above
+        print("execute binds live devices, whose slice topology is "
+              "detected, not configured; drop --slices (use `schedule "
+              "--slices N` for modeled multislice runs)", file=sys.stderr)
+        return 2
     dag = cfg.build_graph()
     if not hasattr(dag, "graph"):
         print("execute needs a model DAG (gpt2* / llama* / mixtral*); "
