@@ -197,23 +197,48 @@ def lm_head(x: jax.Array, w: jax.Array) -> jax.Array:
 
 # -- whole-model forward (fused baseline + correctness oracle) --------------
 
-def forward(
-    params: Dict[str, jax.Array], input_ids: jax.Array, config: LlamaConfig
+def transformer_block(
+    block_params: Dict[str, jax.Array], x: jax.Array, config: LlamaConfig
 ) -> jax.Array:
+    """One layer (RMSNorm + GQA + SwiGLU with residuals), params keyed by
+    the unprefixed names — the rematerialization unit."""
+    h = rms_norm(x, block_params["attn_norm_g"], config.rms_eps)
+    h = gqa_attention(
+        h, block_params["wq"], block_params["wk"], block_params["wv"],
+        block_params["wo"], config.n_heads, config.n_kv_heads,
+        config.rope_theta,
+    )
+    x = residual_add(x, h)
+    h = rms_norm(x, block_params["ffn_norm_g"], config.rms_eps)
+    g = ffn_gate(h, block_params["w_gate"])
+    u = ffn_up(h, block_params["w_up"])
+    h = ffn_down(ffn_glu(g, u), block_params["w_down"])
+    return residual_add(x, h)
+
+
+_BLOCK_KEYS = (
+    "attn_norm_g", "wq", "wk", "wv", "wo", "ffn_norm_g",
+    "w_gate", "w_up", "w_down",
+)
+
+
+def forward(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    remat: bool = False,
+) -> jax.Array:
+    """``remat=True`` checkpoints each block (HBM for FLOPs), as in
+    :func:`..gpt2.forward`."""
+    block = (
+        jax.checkpoint(transformer_block, static_argnums=(2,))
+        if remat
+        else transformer_block
+    )
     x = embedding(input_ids, params["tok_emb"])
     for i in range(config.n_layers):
         p = f"l{i}_"
-        h = rms_norm(x, params[p + "attn_norm_g"], config.rms_eps)
-        h = gqa_attention(
-            h, params[p + "wq"], params[p + "wk"], params[p + "wv"],
-            params[p + "wo"], config.n_heads, config.n_kv_heads, config.rope_theta,
-        )
-        x = residual_add(x, h)
-        h = rms_norm(x, params[p + "ffn_norm_g"], config.rms_eps)
-        g = ffn_gate(h, params[p + "w_gate"])
-        u = ffn_up(h, params[p + "w_up"])
-        h = ffn_down(ffn_glu(g, u), params[p + "w_down"])
-        x = residual_add(x, h)
+        x = block({k: params[p + k] for k in _BLOCK_KEYS}, x, config)
     x = rms_norm(x, params["final_norm_g"], config.rms_eps)
     return lm_head(x, params["lm_head"])
 
@@ -223,8 +248,9 @@ def loss_fn(
     input_ids: jax.Array,
     targets: jax.Array,
     config: LlamaConfig,
+    remat: bool = False,
 ) -> jax.Array:
-    logits = forward(params, input_ids, config)
+    logits = forward(params, input_ids, config, remat=remat)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()

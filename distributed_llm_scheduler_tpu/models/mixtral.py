@@ -165,39 +165,84 @@ def moe_combine(weights: jax.Array, *expert_outs: jax.Array) -> jax.Array:
     return out
 
 
-def moe_block(params: Dict[str, jax.Array], x: jax.Array, layer: int,
-              config: MixtralConfig) -> jax.Array:
-    """Router + dense experts + combine, as the fused oracle composes it."""
-    p = f"l{layer}_"
-    w = router_weights(x, params[p + "router"], config.top_k)
+def _moe(block_params: Dict[str, jax.Array], x: jax.Array,
+         config: MixtralConfig) -> jax.Array:
+    """Router + dense experts + combine over UNPREFIXED param names — the
+    single implementation of the MoE layer math; :func:`moe_block` and
+    :func:`transformer_block` both delegate here so the DAG path and the
+    remat oracle cannot drift."""
+    w = router_weights(x, block_params["router"], config.top_k)
     outs = [
         expert_ffn(
             x,
-            params[f"{p}e{e}_w_gate"],
-            params[f"{p}e{e}_w_up"],
-            params[f"{p}e{e}_w_down"],
+            block_params[f"e{e}_w_gate"],
+            block_params[f"e{e}_w_up"],
+            block_params[f"e{e}_w_down"],
         )
         for e in range(config.n_experts)
     ]
     return moe_combine(w, *outs)
 
 
+def moe_block(params: Dict[str, jax.Array], x: jax.Array, layer: int,
+              config: MixtralConfig) -> jax.Array:
+    """Router + dense experts + combine, as the fused oracle composes it
+    (layer-prefixed params; delegates to :func:`_moe`)."""
+    p = f"l{layer}_"
+    moe_keys = ["router"] + [
+        f"e{e}_{s}"
+        for e in range(config.n_experts)
+        for s in ("w_gate", "w_up", "w_down")
+    ]
+    return _moe({k: params[p + k] for k in moe_keys}, x, config)
+
+
 # -- whole-model forward (fused baseline + correctness oracle) --------------
 
-def forward(
-    params: Dict[str, jax.Array], input_ids: jax.Array, config: MixtralConfig
+def _layer_keys(config: MixtralConfig) -> Tuple[str, ...]:
+    """Unprefixed per-layer param names (the remat block's vocabulary)."""
+    keys = ["attn_norm_g", "wq", "wk", "wv", "wo", "ffn_norm_g", "router"]
+    for e in range(config.n_experts):
+        keys += [f"e{e}_w_gate", f"e{e}_w_up", f"e{e}_w_down"]
+    return tuple(keys)
+
+
+def transformer_block(
+    block_params: Dict[str, jax.Array], x: jax.Array, config: MixtralConfig
 ) -> jax.Array:
+    """One layer (RMSNorm + GQA + router/experts/combine with residuals),
+    params keyed unprefixed — the rematerialization unit.  Same math as
+    the prefixed :func:`moe_block` path."""
+    h = rms_norm(x, block_params["attn_norm_g"], config.rms_eps)
+    h = gqa_attention(
+        h, block_params["wq"], block_params["wk"], block_params["wv"],
+        block_params["wo"], config.n_heads, config.n_kv_heads,
+        config.rope_theta,
+    )
+    x = residual_add(x, h)
+    h = rms_norm(x, block_params["ffn_norm_g"], config.rms_eps)
+    return residual_add(x, _moe(block_params, h, config))
+
+
+def forward(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    remat: bool = False,
+) -> jax.Array:
+    """``remat=True`` checkpoints each block — especially valuable for MoE,
+    whose dense-dispatch expert activations are ``n_experts`` times the
+    dense model's."""
+    block = (
+        jax.checkpoint(transformer_block, static_argnums=(2,))
+        if remat
+        else transformer_block
+    )
+    keys = _layer_keys(config)
     x = embedding(input_ids, params["tok_emb"])
     for i in range(config.n_layers):
         p = f"l{i}_"
-        h = rms_norm(x, params[p + "attn_norm_g"], config.rms_eps)
-        h = gqa_attention(
-            h, params[p + "wq"], params[p + "wk"], params[p + "wv"],
-            params[p + "wo"], config.n_heads, config.n_kv_heads, config.rope_theta,
-        )
-        x = residual_add(x, h)
-        h = rms_norm(x, params[p + "ffn_norm_g"], config.rms_eps)
-        x = residual_add(x, moe_block(params, h, i, config))
+        x = block({k: params[p + k] for k in keys}, x, config)
     x = rms_norm(x, params["final_norm_g"], config.rms_eps)
     return lm_head(x, params["lm_head"])
 
@@ -207,8 +252,9 @@ def loss_fn(
     input_ids: jax.Array,
     targets: jax.Array,
     config: MixtralConfig,
+    remat: bool = False,
 ) -> jax.Array:
-    logits = forward(params, input_ids, config)
+    logits = forward(params, input_ids, config, remat=remat)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
