@@ -103,16 +103,15 @@ def cmd_sweep(args) -> int:
     from .eval.evaluator import Evaluator
 
     cfg = _config_from(args)
-    if cfg.slices > 1:
-        # silently running a flat-topology sweep would misreport the
-        # DCN-aware config the user asked for
-        print("sweep does not support --slices yet; run `schedule --slices "
-              "N` per policy for multislice numbers", file=sys.stderr)
+    try:
+        ev = Evaluator(
+            node_counts=cfg.node_counts,
+            memory_regimes=cfg.memory_regimes,
+            slices=cfg.slices,
+        )
+    except ValueError as e:  # e.g. no node count divisible by --slices
+        print(str(e), file=sys.stderr)
         return 2
-    ev = Evaluator(
-        node_counts=cfg.node_counts,
-        memory_regimes=cfg.memory_regimes,
-    )
     ev.run_experiments(num_runs=args.num_runs, seed=cfg.seed)
     print("csv ->", ev.write_csv(f"{cfg.out_dir}/raw_results.csv"))
     print("png ->", ev.write_plots(f"{cfg.out_dir}/scheduler_performance.png"))
@@ -127,7 +126,7 @@ def cmd_execute(args) -> int:
     if cfg.slices > 1:
         # live clusters carry their REAL slice topology (from_jax_devices
         # reads device.slice_index); an artificial --slices would silently
-        # not apply, like the sweep guard above
+        # not apply
         print("execute binds live devices, whose slice topology is "
               "detected, not configured; drop --slices (use `schedule "
               "--slices N` for modeled multislice runs)", file=sys.stderr)

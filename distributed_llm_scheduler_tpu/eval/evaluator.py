@@ -35,9 +35,39 @@ class Evaluator:
         backend: Optional[SimulatedBackend] = None,
         node_counts: Sequence[int] = DEFAULT_NODE_COUNTS,
         memory_regimes: Sequence[float] = DEFAULT_MEMORY_REGIMES,
+        slices: int = 1,
     ):
+        """``slices > 1`` runs the whole sweep on multi-slice topologies:
+        clusters split slice-by-slice (even memory, speed 1.0 — slice
+        membership replaces the heterogeneous-speed profile), the replay
+        charges DCN on cross-slice edges (``TieredLinkModel``), and link-
+        aware policies place against the same tiered costs.  Node counts
+        not divisible by ``slices`` are skipped with a warning."""
         self.scheduler_names = list(schedulers or sorted(ALL_SCHEDULERS))
         self.workloads = dict(workloads or SWEEP_WORKLOADS)
+        self.slices = slices
+        self.link = None
+        if slices > 1:
+            from ..backends.sim import TieredLinkModel
+
+            if backend is None:
+                self.link = TieredLinkModel()
+                backend = SimulatedBackend(fidelity="full", link=self.link)
+            elif isinstance(getattr(backend, "link", None), TieredLinkModel):
+                self.link = backend.link
+            else:
+                # a flat-link backend on multislice clusters would silently
+                # never charge DCN — the misreporting this class exists to
+                # prevent
+                raise ValueError(
+                    "slices > 1 needs a backend whose link is a "
+                    "TieredLinkModel (or backend=None to build one)"
+                )
+            if not any(n % slices == 0 for n in node_counts):
+                raise ValueError(
+                    f"no node count in {tuple(node_counts)} is divisible by "
+                    f"slices={slices}; the sweep would be empty"
+                )
         self.backend = backend or SimulatedBackend(fidelity="full")
         self.node_counts = list(node_counts)
         self.memory_regimes = list(memory_regimes)
@@ -52,11 +82,20 @@ class Evaluator:
         dag_type: str = "unknown",
         memory_regime: float = 1.0,
     ) -> ExecutionReport:
-        sched = get_scheduler(scheduler_name)
+        sched = get_scheduler(scheduler_name, link=self.link)
         schedule = sched.schedule(graph, cluster)
         return self.backend.execute(
             graph, cluster, schedule, dag_type=dag_type, memory_regime=memory_regime
         )
+
+    def _make_cluster(self, needed: float, regime: float, n_nodes: int, rng):
+        if self.slices > 1:
+            return Cluster.multislice(
+                self.slices,
+                n_nodes // self.slices,
+                needed * regime / n_nodes,
+            )
+        return Cluster.heterogeneous(needed * regime, n_nodes, rng=rng)
 
     # -- sweep -------------------------------------------------------------
     def run_experiments(self, num_runs: int = 3, seed: int = 0) -> List[ExecutionReport]:
@@ -79,10 +118,16 @@ class Evaluator:
                 )
                 needed = estimate_cluster_memory_needed(graph)
                 for n_nodes in self.node_counts:
+                    if self.slices > 1 and n_nodes % self.slices:
+                        warnings.warn(
+                            f"skipping n_nodes={n_nodes}: not divisible by "
+                            f"slices={self.slices}"
+                        )
+                        continue
                     for regime in self.memory_regimes:
                         rng = random.Random(seed + run_idx)
-                        cluster = Cluster.heterogeneous(
-                            needed * regime, n_nodes, rng=rng
+                        cluster = self._make_cluster(
+                            needed, regime, n_nodes, rng
                         )
                         for name in self.scheduler_names:
                             try:
