@@ -163,10 +163,23 @@ def measure(
     rep = backend.execute(graph, sched_one, params, ids)  # warmup=True
     fused_fn = jax.jit(dag.reference_forward)
     fused = fused_fn(params, ids)
-    jax.block_until_ready(fused)
+    # fence-amortized timing: block_until_ready is unreliable through the
+    # axon tunnel (utils/costmodel.readback_fence) — queue K forwards and
+    # force completion with one readback, netting out the fence round-trip
+    from distributed_llm_scheduler_tpu.utils.costmodel import (
+        _fence_rtt,
+        readback_fence,
+    )
+
+    readback_fence(fused)
+    rtt = _fence_rtt(devices[0])
+    reps = 8
     t0 = time.perf_counter()
-    jax.block_until_ready(fused_fn(params, ids))
-    fused_wall_s = time.perf_counter() - t0
+    out = None
+    for _ in range(reps):
+        out = fused_fn(params, ids)
+    readback_fence(out)
+    fused_wall_s = max(time.perf_counter() - t0 - rtt, 1e-9) / reps
     # bf16 carries ~8 mantissa bits; fusion-order differences show up at ~1%
     tol = 2e-4 if dag.config.dtype == jnp.float32 else 5e-2
     oracle_ok = bool(

@@ -20,9 +20,12 @@ reference *simulates* completion inside ``assign_task_to_node`` (reference
   topological order — a policy that computed a 1F1B microbatch interleaving
   (sched/eventsim.py) gets that interleaving in real execution, where
   Kahn-wave dispatch would re-introduce the head-of-line blocking the
-  ordering was computed to avoid.  A single ``block_until_ready`` fence
-  measures makespan, or per-task fences in ``profile`` mode feed the
-  measured cost model.
+  ordering was computed to avoid.  Makespan is measured with end-of-run
+  readback fences (one per device, fixed round-trip netted out) because
+  ``block_until_ready`` is unreliable through the axon tunnel
+  (``utils/costmodel.readback_fence``); the measured cost model uses the
+  fence-amortized ``utils/costmodel.calibrate``, NOT this backend's
+  ``profile`` mode.
 
 Works identically on a real TPU slice and on the CPU-faked 8-device mesh
 (``--xla_force_host_platform_device_count``), which is how tests exercise
@@ -110,6 +113,9 @@ class DeviceBackend:
         # fn object -> jitted fn; survives across execute() calls so
         # benchmark reruns don't pay compilation again
         self._jit_cache: Dict[Any, Callable[..., Any]] = {}
+        # per-device readback-fence round-trips (measured lazily at first
+        # execute; keyed by jax device)
+        self._fence_rtt_s: Dict[Any, float] = {}
 
     # -- placement ---------------------------------------------------------
     def place_params(
@@ -250,7 +256,7 @@ class DeviceBackend:
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
         profile: bool,
-    ) -> Tuple[Any, Dict[str, TaskTiming], int, int]:
+    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, List[Tuple[Any, Any]]]:
         placement = schedule.placement
         outputs: Dict[str, Any] = {}
         timings: Dict[str, TaskTiming] = {}
@@ -258,7 +264,8 @@ class DeviceBackend:
         transfer_bytes = 0
         t_start = time.perf_counter()
 
-        for tid in self.dispatch_order(graph, schedule):
+        order = self.dispatch_order(graph, schedule)
+        for tid in order:
             if tid not in placement:
                 continue  # failed task: skip (fail-and-continue semantics)
             task = graph[tid]
@@ -299,11 +306,26 @@ class DeviceBackend:
             outputs[tid] = out
 
         # fence ALL dispatched work (not just the topologically-last task:
-        # multi-leaf graphs and skipped tails would otherwise under-measure)
+        # multi-leaf graphs and skipped tails would otherwise under-measure).
+        # block_until_ready first, then a per-device readback fence:
+        # block_until_ready is unreliable through the axon tunnel (it can
+        # return before compute completes — utils/costmodel.readback_fence),
+        # and per-device queues are FIFO so one fenced value per device
+        # proves that device's whole queue drained.
+        fenced: List[Tuple[Any, Any]] = []  # (jax_device, fenced output)
         if outputs:
+            from ..utils.costmodel import readback_fence
+
             jax.block_until_ready(list(outputs.values()))
+            last_on_device: Dict[str, Any] = {}
+            for tid in order:
+                if tid in outputs:
+                    last_on_device[placement[tid]] = outputs[tid]
+            for nid, out in last_on_device.items():
+                readback_fence(out)
+                fenced.append((self.cluster[nid].jax_device, out))
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
-        return final, timings, transfer_edges, transfer_bytes
+        return final, timings, transfer_edges, transfer_bytes, fenced
 
     def execute(
         self,
@@ -316,9 +338,14 @@ class DeviceBackend:
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
-        ``profile=True`` fences every task for per-task wall times (slower;
-        use for cost-model calibration and Gantt charts).  ``profile=False``
-        measures pure asynchronous dispatch makespan.
+        ``profile=True`` records per-task wall times via per-task
+        ``block_until_ready`` (Gantt charts / diagnostics).  CAVEAT: on the
+        tunneled TPU those per-task fences are unreliable (they can return
+        at dispatch, not completion — see ``utils/costmodel``), so profile
+        timings are trustworthy on local platforms (CPU mesh) only;
+        cost-model calibration uses the fence-amortized
+        ``utils/costmodel.calibrate`` instead.  ``profile=False`` measures
+        makespan with per-device readback fences, RTT netted out.
         """
         graph.freeze()
         no_fn = [t.task_id for t in graph if t.fn is None]
@@ -336,11 +363,23 @@ class DeviceBackend:
         if warmup:
             compile_s = self.warmup(graph, schedule, placed, graph_input)
 
+        # per-device fence round-trips, measured once each (outside the
+        # timed region): the end-of-run readback fences add this fixed
+        # latency per fenced device, which is tunnel/host RTT, not device
+        # work — and RTT can differ per device on multislice topologies
+        from ..utils.costmodel import _fence_rtt
+
+        for d in self.cluster:
+            if d.jax_device not in self._fence_rtt_s:
+                self._fence_rtt_s[d.jax_device] = _fence_rtt(d.jax_device)
+
         t0 = time.perf_counter()
-        output, timings, tedges, tbytes = self._run(
+        output, timings, tedges, tbytes, fenced = self._run(
             graph, schedule, placed, graph_input, profile
         )
-        makespan = time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+        fence_cost = sum(self._fence_rtt_s[dev] for dev, _ in fenced)
+        makespan = max(wall - fence_cost, 1e-9)
 
         peaks: Dict[str, int] = {}
         for d in self.cluster:
