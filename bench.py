@@ -168,18 +168,17 @@ def measure(
     # force completion with one readback, netting out the fence round-trip
     from distributed_llm_scheduler_tpu.utils.costmodel import (
         _fence_rtt,
+        _output_capped_reps,
         readback_fence,
+        time_amortized,
     )
 
     readback_fence(fused)
     rtt = _fence_rtt(devices[0])
-    reps = 8
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(reps):
-        out = fused_fn(params, ids)
-    readback_fence(out)
-    fused_wall_s = max(time.perf_counter() - t0 - rtt, 1e-9) / reps
+    reps = _output_capped_reps(fused, 8)
+    fused_wall_s = max(
+        time_amortized(lambda: fused_fn(params, ids), reps, rtt), 1e-9
+    )
     # bf16 carries ~8 mantissa bits; fusion-order differences show up at ~1%
     tol = 2e-4 if dag.config.dtype == jnp.float32 else 5e-2
     oracle_ok = bool(

@@ -113,9 +113,10 @@ class DeviceBackend:
         # fn object -> jitted fn; survives across execute() calls so
         # benchmark reruns don't pay compilation again
         self._jit_cache: Dict[Any, Callable[..., Any]] = {}
-        # per-device readback-fence round-trips (measured lazily at first
-        # execute; keyed by jax device)
-        self._fence_rtt_s: Dict[Any, float] = {}
+
+    def _fence_device(self):
+        """The device the end-of-run fence reads back from."""
+        return self.cluster.devices[0].jax_device
 
     # -- placement ---------------------------------------------------------
     def place_params(
@@ -312,20 +313,34 @@ class DeviceBackend:
         # return before compute completes — utils/costmodel.readback_fence),
         # and per-device queues are FIFO so one fenced value per device
         # proves that device's whole queue drained.
-        fenced: List[Tuple[Any, Any]] = []  # (jax_device, fenced output)
+        n_fences = 0
         if outputs:
             from ..utils.costmodel import readback_fence
 
             jax.block_until_ready(list(outputs.values()))
+            # ONE fence for the whole run: pull a single element of each
+            # device's last output onto the fence device and read back
+            # their (dependent) combination.  One RTT regardless of device
+            # count — per-device sequential fences would over-subtract
+            # when an early fence's round-trip overlaps a straggler
+            # device's remaining compute.
             last_on_device: Dict[str, Any] = {}
             for tid in order:
                 if tid in outputs:
                     last_on_device[placement[tid]] = outputs[tid]
-            for nid, out in last_on_device.items():
-                readback_fence(out)
-                fenced.append((self.cluster[nid].jax_device, out))
+            fence_dev = self._fence_device()
+            tips = []
+            for out in last_on_device.values():
+                leaf = jax.tree_util.tree_leaves(out)[-1]
+                tip = leaf[(0,) * leaf.ndim]
+                tips.append(jax.device_put(tip, fence_dev))
+            combined = tips[0]
+            for t in tips[1:]:
+                combined = combined + t.astype(combined.dtype)
+            readback_fence(combined)
+            n_fences = 1
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
-        return final, timings, transfer_edges, transfer_bytes, fenced
+        return final, timings, transfer_edges, transfer_bytes, n_fences
 
     def execute(
         self,
@@ -363,23 +378,20 @@ class DeviceBackend:
         if warmup:
             compile_s = self.warmup(graph, schedule, placed, graph_input)
 
-        # per-device fence round-trips, measured once each (outside the
-        # timed region): the end-of-run readback fences add this fixed
-        # latency per fenced device, which is tunnel/host RTT, not device
-        # work — and RTT can differ per device on multislice topologies
+        # fence round-trip, re-measured per execute (outside the timed
+        # region): tunnel RTT demonstrably changes across reconnects, so a
+        # backend-lifetime cache would correct post-reconnect runs with a
+        # stale value and bias cross-policy comparisons
         from ..utils.costmodel import _fence_rtt
 
-        for d in self.cluster:
-            if d.jax_device not in self._fence_rtt_s:
-                self._fence_rtt_s[d.jax_device] = _fence_rtt(d.jax_device)
+        rtt = _fence_rtt(self._fence_device())
 
         t0 = time.perf_counter()
-        output, timings, tedges, tbytes, fenced = self._run(
+        output, timings, tedges, tbytes, n_fences = self._run(
             graph, schedule, placed, graph_input, profile
         )
         wall = time.perf_counter() - t0
-        fence_cost = sum(self._fence_rtt_s[dev] for dev, _ in fenced)
-        makespan = max(wall - fence_cost, 1e-9)
+        makespan = max(wall - n_fences * rtt, 1e-9)
 
         peaks: Dict[str, int] = {}
         for d in self.cluster:

@@ -83,6 +83,41 @@ def readback_fence(x: Any) -> None:
     np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
 
 
+def time_amortized(call: Any, reps: int, rtt: float) -> float:
+    """Seconds per call: enqueue ``reps`` executions back-to-back, force
+    completion with ONE readback fence, net out the fence round-trip.
+
+    The one fence-amortized timing idiom, shared by :func:`calibrate` and
+    bench.py so the method can't silently diverge between them.
+    """
+    import time
+
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = call()
+    readback_fence(out)
+    return max(time.perf_counter() - t0 - rtt, 0.0) / reps
+
+
+def _output_capped_reps(out: Any, reps: int, budget_bytes: int = 1 << 30) -> int:
+    """Cap in-flight repetitions so queued output buffers stay under
+    ``budget_bytes``: async dispatch can run ~reps outputs ahead of
+    compute, and 32 live copies of a batch*seq*vocab logits tensor would
+    OOM a 16 GB chip in exactly the degraded paths calibration must
+    survive."""
+    import jax
+    import numpy as np
+
+    out_bytes = sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(out)
+    )
+    if out_bytes <= 0:
+        return reps
+    return max(1, min(reps, budget_bytes // max(out_bytes, 1)))
+
+
 def _fence_rtt(device: Any, samples: int = 5) -> float:
     """Median round-trip of a fence on a trivial value: the fixed cost to
     subtract from fenced timings (dominated by tunnel/host latency)."""
@@ -178,15 +213,12 @@ def calibrate(
         rep_tid = tids[0]
         pd, args = task_args[rep_tid]
         fn = jitted[graph[rep_tid].fn]
+        reps = _output_capped_reps(outputs[rep_tid], reps_per_group)
         best = float("inf")
         for _ in range(repeats):
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(reps_per_group):
-                out = fn(pd, *args)
-            readback_fence(out)
-            wall = time.perf_counter() - t0
-            best = min(best, max(wall - rtt, 0.0) / reps_per_group)
+            best = min(
+                best, time_amortized(lambda: fn(pd, *args), reps, rtt)
+            )
         for tid in tids:
             times[tid] = max(best, 1e-7)
     return CostModel(graph.name, device.platform, times)

@@ -233,3 +233,34 @@ def test_vocab_shard_sizes_cover_vocab():
 def test_vocab_shards_validation():
     with pytest.raises(ValueError, match="vocab_shards"):
         build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=16, vocab_shards=0)
+
+
+def test_costmodel_groups_structurally_identical_tasks(tiny_dag):
+    """Fence-amortized calibration measures one representative per
+    (fn, shapes) group: every layer's attention gets the SAME measured
+    time, and distinct op classes get positive, distinct entries."""
+    from distributed_llm_scheduler_tpu.utils.costmodel import calibrate
+
+    cm = calibrate(
+        tiny_dag.graph, tiny_dag.init_params(), tiny_dag.make_inputs(),
+        repeats=1, reps_per_group=4,
+    )
+    assert set(cm.task_seconds) == set(tiny_dag.graph.task_ids())
+    assert all(t > 0 for t in cm.task_seconds.values())
+    attn = {
+        tid: s for tid, s in cm.task_seconds.items() if "attention" in tid
+    }
+    assert len(attn) >= 2 and len(set(attn.values())) == 1
+
+
+def test_readback_fence_forces_completion():
+    """The fence returns only after the value is host-visible (smoke: it
+    must work on pytrees and scalars alike)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llm_scheduler_tpu.utils.costmodel import readback_fence
+
+    readback_fence(jnp.ones((3, 4)) * 2.0)
+    readback_fence({"a": jnp.zeros((2,)), "b": jnp.ones(())})
+    readback_fence(jax.jit(lambda x: x @ x.T)(jnp.ones((8, 8))))
