@@ -123,13 +123,13 @@ def main() -> None:
 
     measure(
         dag, graph, params, ids, devices, platform, cost_suffix,
-        f32_fallback, t_start,
+        f32_fallback, t_start, dispatch_s=cm.dispatch_s,
     )
 
 
 def measure(
     dag, graph, params, ids, devices, platform, cost_suffix,
-    f32_fallback, t_start,
+    f32_fallback, t_start, dispatch_s: float = 0.0,
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -226,7 +226,7 @@ def measure(
         f"host {link.param_load_gbps:.1f} GB/s, "
         f"ici {link.interconnect_gbps:.1f} GB/s, "
         f"latency {link.latency_s*1e6:.1f} us")
-    sim = SimulatedBackend(fidelity="full", link=link)
+    sim = SimulatedBackend(fidelity="full", link=link, dispatch_s=dispatch_s)
 
     makespans = {}
     schedules = {}

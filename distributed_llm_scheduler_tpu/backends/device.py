@@ -20,12 +20,12 @@ reference *simulates* completion inside ``assign_task_to_node`` (reference
   topological order — a policy that computed a 1F1B microbatch interleaving
   (sched/eventsim.py) gets that interleaving in real execution, where
   Kahn-wave dispatch would re-introduce the head-of-line blocking the
-  ordering was computed to avoid.  Makespan is measured with end-of-run
-  readback fences (one per device, fixed round-trip netted out) because
-  ``block_until_ready`` is unreliable through the axon tunnel
-  (``utils/costmodel.readback_fence``); the measured cost model uses the
-  fence-amortized ``utils/costmodel.calibrate``, NOT this backend's
-  ``profile`` mode.
+  ordering was computed to avoid.  Makespan ends at ONE readback fence
+  whose value depends on every device's last output (its fixed round-trip
+  netted out) because ``block_until_ready`` is unreliable through the
+  axon tunnel (``utils/costmodel.readback_fence``); on such platforms the
+  measured cost model uses the fence-amortized
+  ``utils/costmodel.calibrate``, NOT this backend's ``profile`` mode.
 
 Works identically on a real TPU slice and on the CPU-faked 8-device mesh
 (``--xla_force_host_platform_device_count``), which is how tests exercise
@@ -257,7 +257,7 @@ class DeviceBackend:
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
         profile: bool,
-    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, List[Tuple[Any, Any]]]:
+    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int]:
         placement = schedule.placement
         outputs: Dict[str, Any] = {}
         timings: Dict[str, TaskTiming] = {}
@@ -358,9 +358,9 @@ class DeviceBackend:
         tunneled TPU those per-task fences are unreliable (they can return
         at dispatch, not completion — see ``utils/costmodel``), so profile
         timings are trustworthy on local platforms (CPU mesh) only;
-        cost-model calibration uses the fence-amortized
-        ``utils/costmodel.calibrate`` instead.  ``profile=False`` measures
-        makespan with per-device readback fences, RTT netted out.
+        ``utils/costmodel.calibrate`` picks the right method per platform.
+        ``profile=False`` measures makespan ending at a single combined
+        readback fence, its round-trip netted out.
         """
         graph.freeze()
         no_fn = [t.task_id for t in graph if t.fn is None]

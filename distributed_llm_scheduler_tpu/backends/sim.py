@@ -184,13 +184,19 @@ class SimulatedBackend:
     """
 
     def __init__(self, fidelity: str = "full", link: Optional[LinkModel] = None,
-                 prefetch_params: bool = True, host_slots: Optional[int] = None):
+                 prefetch_params: bool = True, host_slots: Optional[int] = None,
+                 dispatch_s: float = 0.0):
         if fidelity not in ("full", "reference"):
             raise ValueError(f"fidelity must be 'full' or 'reference', got {fidelity!r}")
         if host_slots is not None and host_slots < 1:
             raise ValueError(f"host_slots must be >= 1, got {host_slots}")
         self.fidelity = fidelity
         self.prefetch_params = prefetch_params and fidelity == "full"
+        # per-task HOST dispatch cost (measured: utils/costmodel): one
+        # Python dispatcher enqueues tasks serially in assignment order,
+        # so task i cannot start before (i+1) * dispatch_s even when its
+        # device/inputs are ready — visible on fine-grained DAGs
+        self.dispatch_s = dispatch_s if fidelity == "full" else 0.0
         # Shared-substrate cap: at most this many tasks execute concurrently
         # across ALL nodes.  Real TPU cores are independent (None =
         # unlimited, the default); the CPU-faked mesh shares the host's
@@ -248,10 +254,12 @@ class SimulatedBackend:
 
         # Execute in global assignment order (the order the scheduler decided),
         # which respects dependencies by construction.
+        host_clock = 0.0  # serial dispatcher position
         for tid in schedule.assignment_order:
             task = graph[tid]
             node_id = placement[tid]
             cache = caches[node_id]
+            host_clock += self.dispatch_s
 
             # parameter loads
             load_time = 0.0
@@ -274,7 +282,7 @@ class SimulatedBackend:
                         params_ready = max(params_ready, load_queue_end[node_id])
             param_load_total += load_time
 
-            start = node_clock[node_id]
+            start = max(node_clock[node_id], host_clock)
             if self.fidelity == "full":
                 # dependency wait: inputs must exist; cross-node edges pay ICI
                 for d in task.dependencies:

@@ -168,10 +168,11 @@ def choose_cost_model(
     cached_tpu = os.path.join(cache_dir, f"{graph.name}_tpu.json")
     if os.path.exists(cached_tpu):
         cm = CostModel.load(cached_tpu)
-        if set(cm.task_seconds) == set(graph.task_ids()):
+        if cm.method and set(cm.task_seconds) == set(graph.task_ids()):
             log(f"bench: using cached TPU calibration {cached_tpu}")
             return cm, "_tpu_cached"
-        log(f"bench: cached TPU calibration {cached_tpu} is stale (task set)")
+        log(f"bench: cached TPU calibration {cached_tpu} is stale "
+            "(task set or pre-method format)")
 
     # live calibration on the actual (non-TPU) platform — needed both as
     # the derivation source and as the last-resort model

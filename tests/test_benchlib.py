@@ -132,7 +132,9 @@ def _graph(name, tids):
 
 def test_choose_cost_model_prefers_cached_tpu(tmp_path, monkeypatch):
     g = _graph("flagship", ["a", "b"])
-    cached = CostModel("flagship", "tpu", {"a": 0.001, "b": 0.002})
+    cached = CostModel(
+        "flagship", "tpu", {"a": 0.001, "b": 0.002}, method="amortized"
+    )
     cached.save(str(tmp_path / "flagship_tpu.json"))
     cm, suffix = choose_cost_model(
         g, {}, None, _FakeDevice("cpu"), cache_dir=str(tmp_path),
@@ -286,3 +288,34 @@ def test_bench_result_tpu_measured_metric_has_no_suffix():
     )
     assert r.metric == "gpt2s_fwd_dag_makespan_best_of_7_policies"
     assert r.to_json()["fallback"] is False
+
+
+def test_choose_cost_model_rejects_pre_method_cache(tmp_path, monkeypatch):
+    """Caches written before the method field must not be reused: their
+    per-task semantics (and missing dispatch_s) would silently mix with
+    current calibrations."""
+    import json
+
+    g = _graph("flagship", ["a", "b"])
+    path = tmp_path / "flagship_tpu.json"
+    legacy = {
+        "graph_name": "flagship", "platform": "tpu",
+        "task_seconds": {"a": 0.001, "b": 0.002},
+    }  # no "method" key
+    path.write_text(json.dumps(legacy))
+
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+        return CostModel(
+            graph.name, device.platform, {"a": 1.0, "b": 1.0},
+            method="profile",
+        )
+
+    monkeypatch.setattr(
+        "distributed_llm_scheduler_tpu.utils.costmodel.calibrate_cached",
+        fake_calibrate_cached,
+    )
+    cm, suffix = choose_cost_model(
+        g, {}, None, _FakeDevice("cpu"), cache_dir=str(tmp_path),
+        log=lambda m: None,
+    )
+    assert suffix == "_cpu"  # fell through to live calibration

@@ -235,13 +235,16 @@ def test_vocab_shards_validation():
         build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=16, vocab_shards=0)
 
 
-def test_costmodel_groups_structurally_identical_tasks(tiny_dag):
+def test_costmodel_groups_structurally_identical_tasks(tiny_dag, monkeypatch):
     """Fence-amortized calibration measures one representative per
     (fn, shapes) group: every layer's attention gets the SAME measured
-    time, and distinct op classes get positive, distinct entries."""
-    from distributed_llm_scheduler_tpu.utils.costmodel import calibrate
+    time, and distinct op classes get positive, distinct entries.
+    (Forced onto the amortized path — on the healthy-fence CPU platform
+    calibrate would pick the serial profile method instead.)"""
+    from distributed_llm_scheduler_tpu.utils import costmodel
 
-    cm = calibrate(
+    monkeypatch.setattr(costmodel, "blocking_reliable", lambda d: False)
+    cm = costmodel.calibrate(
         tiny_dag.graph, tiny_dag.init_params(), tiny_dag.make_inputs(),
         repeats=1, reps_per_group=4,
     )

@@ -124,7 +124,8 @@ def test_sim_tracks_real_execution():
     cal = calibrate_link(
         jax.devices(), sizes=(1 << 14, 1 << 18, 1 << 22), repeats=3
     )
-    calibrate(g, params, ids, repeats=2).apply(g)
+    cm = calibrate(g, params, ids, repeats=2)
+    cm.apply(g)
 
     cluster = Cluster.from_jax_devices(hbm_cap_gb=4.0)
     backend = DeviceBackend(cluster)
@@ -132,6 +133,7 @@ def test_sim_tracks_real_execution():
         fidelity="full",
         link=cal.to_link_model(),
         host_slots=os.cpu_count() or 1,
+        dispatch_s=cm.dispatch_s,
     )
     ratios = {}
     for policy in ("roundrobin", "pipeline", "critical"):
