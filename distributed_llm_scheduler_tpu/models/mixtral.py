@@ -224,6 +224,36 @@ def transformer_block(
     return residual_add(x, _moe(block_params, h, config))
 
 
+def forward_with_block(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    block_fn: Any,
+    layer_keys: Tuple[str, ...],
+    remat: bool = False,
+) -> jax.Array:
+    """The one Mixtral forward skeleton: embed -> n_layers x block ->
+    final norm -> LM head.  Parameterized by the layer block so the
+    per-expert path (:func:`forward`) and the stacked EP path
+    (``parallel/expert.forward_ep``) share it instead of drifting."""
+    block = (
+        jax.checkpoint(block_fn, static_argnums=(2,)) if remat else block_fn
+    )
+    x = embedding(input_ids, params["tok_emb"])
+    for i in range(config.n_layers):
+        p = f"l{i}_"
+        x = block({k: params[p + k] for k in layer_keys}, x, config)
+    x = rms_norm(x, params["final_norm_g"], config.rms_eps)
+    return lm_head(x, params["lm_head"])
+
+
+def nll_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Next-token cross-entropy in float32 (shared by both MoE paths)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
 def forward(
     params: Dict[str, jax.Array],
     input_ids: jax.Array,
@@ -233,18 +263,10 @@ def forward(
     """``remat=True`` checkpoints each block — especially valuable for MoE,
     whose dense-dispatch expert activations are ``n_experts`` times the
     dense model's."""
-    block = (
-        jax.checkpoint(transformer_block, static_argnums=(2,))
-        if remat
-        else transformer_block
+    return forward_with_block(
+        params, input_ids, config, transformer_block, _layer_keys(config),
+        remat=remat,
     )
-    keys = _layer_keys(config)
-    x = embedding(input_ids, params["tok_emb"])
-    for i in range(config.n_layers):
-        p = f"l{i}_"
-        x = block({k: params[p + k] for k in keys}, x, config)
-    x = rms_norm(x, params["final_norm_g"], config.rms_eps)
-    return lm_head(x, params["lm_head"])
 
 
 def loss_fn(
@@ -254,7 +276,4 @@ def loss_fn(
     config: MixtralConfig,
     remat: bool = False,
 ) -> jax.Array:
-    logits = forward(params, input_ids, config, remat=remat)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return nll_loss(forward(params, input_ids, config, remat=remat), targets)

@@ -81,19 +81,18 @@ def unstack_expert_params(
     return out
 
 
-def moe_block_stacked(
-    params: Dict[str, Any], x: jax.Array, layer: int, config: MixtralConfig
+def _moe_stacked(
+    block_params: Dict[str, Any], x: jax.Array, config: MixtralConfig
 ) -> jax.Array:
-    """Router + stacked-expert SwiGLU + combine, einsum-only.
-
-    Matches :func:`..models.mixtral.moe_block` numerically (same math,
-    reassociated); under a mesh the ``e`` dims below partition over ``ep``
-    and the final contraction becomes the cross-expert psum.
-    """
-    p = f"l{layer}_"
-    w = mixtral.router_weights(x, params[p + "router"], config.top_k)
+    """Router + stacked-expert SwiGLU + combine over UNPREFIXED names —
+    the single implementation of the stacked MoE math (cf.
+    ``models.mixtral._moe`` for the per-expert layout).  Under a mesh the
+    ``e`` dims partition over ``ep`` and the final contraction becomes
+    the cross-expert psum."""
+    w = mixtral.router_weights(x, block_params["router"], config.top_k)
     gate, up, down = (
-        params[p + "moe_gate"], params[p + "moe_up"], params[p + "moe_down"]
+        block_params["moe_gate"], block_params["moe_up"],
+        block_params["moe_down"],
     )
     g = jax.nn.silu(jnp.einsum("btd,edf->ebtf", x, gate))
     u = jnp.einsum("btd,edf->ebtf", x, up)
@@ -101,35 +100,61 @@ def moe_block_stacked(
     return jnp.einsum("bte,ebtd->btd", w, y).astype(x.dtype)
 
 
+def moe_block_stacked(
+    params: Dict[str, Any], x: jax.Array, layer: int, config: MixtralConfig
+) -> jax.Array:
+    """Layer-prefixed wrapper over :func:`_moe_stacked` (matches
+    :func:`..models.mixtral.moe_block` numerically — same math,
+    reassociated)."""
+    p = f"l{layer}_"
+    keys = ("router", "moe_gate", "moe_up", "moe_down")
+    return _moe_stacked({k: params[p + k] for k in keys}, x, config)
+
+
+_EP_BLOCK_KEYS = (
+    "attn_norm_g", "wq", "wk", "wv", "wo", "ffn_norm_g", "router",
+    "moe_gate", "moe_up", "moe_down",
+)
+
+
+def _ep_block(
+    block_params: Dict[str, Any], x: jax.Array, config: MixtralConfig
+) -> jax.Array:
+    """One EP layer (unprefixed params) — the rematerialization unit."""
+    h = mixtral.rms_norm(x, block_params["attn_norm_g"], config.rms_eps)
+    h = mixtral.gqa_attention(
+        h, block_params["wq"], block_params["wk"], block_params["wv"],
+        block_params["wo"], config.n_heads, config.n_kv_heads,
+        config.rope_theta,
+    )
+    x = mixtral.residual_add(x, h)
+    h = mixtral.rms_norm(x, block_params["ffn_norm_g"], config.rms_eps)
+    return mixtral.residual_add(x, _moe_stacked(block_params, h, config))
+
+
 def forward_ep(
-    params: Dict[str, Any], input_ids: jax.Array, config: MixtralConfig
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    remat: bool = False,
 ) -> jax.Array:
     """Mixtral forward over stacked expert params (the EP train/eval path).
 
-    Identical layer structure to :func:`..models.mixtral.forward`; only the
-    MoE block differs in layout.
+    Shares :func:`..models.mixtral.forward_with_block`'s skeleton; only
+    the layer block differs in layout.  ``remat=True`` checkpoints each
+    layer — especially valuable under EP, where the dense-dispatch expert
+    activations ``(E, B, T, ffn)`` dominate HBM.
     """
-    x = mixtral.embedding(input_ids, params["tok_emb"])
-    for i in range(config.n_layers):
-        p = f"l{i}_"
-        h = mixtral.rms_norm(x, params[p + "attn_norm_g"], config.rms_eps)
-        h = mixtral.gqa_attention(
-            h, params[p + "wq"], params[p + "wk"], params[p + "wv"],
-            params[p + "wo"], config.n_heads, config.n_kv_heads,
-            config.rope_theta,
-        )
-        x = mixtral.residual_add(x, h)
-        h = mixtral.rms_norm(x, params[p + "ffn_norm_g"], config.rms_eps)
-        x = mixtral.residual_add(x, moe_block_stacked(params, h, i, config))
-    x = mixtral.rms_norm(x, params["final_norm_g"], config.rms_eps)
-    return mixtral.lm_head(x, params["lm_head"])
+    return mixtral.forward_with_block(
+        params, input_ids, config, _ep_block, _EP_BLOCK_KEYS, remat=remat
+    )
 
 
-def loss_fn_ep(params, input_ids, targets, config: MixtralConfig):
-    logits = forward_ep(params, input_ids, config)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+def loss_fn_ep(params, input_ids, targets, config: MixtralConfig,
+               remat: bool = False):
+    return mixtral.nll_loss(
+        forward_ep(params, input_ids, config, remat=remat), targets
+    )
 
 
 # -- sharding rules ----------------------------------------------------------
@@ -161,6 +186,7 @@ def make_moe_train_step(
     mesh: Mesh,
     optimizer: Optional[Any] = None,
     learning_rate: float = 3e-4,
+    remat: bool = False,
 ) -> Tuple[Callable[..., Any], Callable[..., Any]]:
     """dp x ep sharded Mixtral training step; returns ``(step, init)``.
 
@@ -168,7 +194,7 @@ def make_moe_train_step(
     sharded stacked params + optimizer state on the mesh; ``step(state,
     ids, targets) -> (state, loss)`` is one jitted program with donated
     state.  The mesh must define ``dp`` and ``ep`` axes (``ep`` must divide
-    ``n_experts``).
+    ``n_experts``).  ``remat=True`` checkpoints each layer.
     """
     import optax
 
@@ -194,7 +220,7 @@ def make_moe_train_step(
 
     def step_fn(state: TrainState, input_ids, targets):
         loss, grads = jax.value_and_grad(loss_fn_ep)(
-            state.params, input_ids, targets, config
+            state.params, input_ids, targets, config, remat
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params

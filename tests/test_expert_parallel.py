@@ -114,3 +114,30 @@ def test_ep_train_loss_matches_unsharded_step(tiny):
     expect = float(mixtral.loss_fn(params, ids, targets, cfg))
     _, loss = step(state, ids, targets)
     assert abs(float(loss) - expect) < 1e-4
+
+
+def test_ep_remat_matches(tiny):
+    cfg, params, ids, targets = tiny
+    stacked = stack_expert_params(params, cfg)
+    plain = forward_ep(stacked, ids, cfg)
+    remat = forward_ep(stacked, ids, cfg, remat=True)
+    np.testing.assert_allclose(np.asarray(remat), np.asarray(plain),
+                               rtol=1e-6, atol=1e-6)
+    g_plain = jax.grad(loss_fn_ep)(stacked, ids, targets, cfg)
+    g_remat = jax.grad(loss_fn_ep)(stacked, ids, targets, cfg, remat=True)
+    for k in g_plain:
+        np.testing.assert_allclose(
+            np.asarray(g_remat[k]), np.asarray(g_plain[k]),
+            rtol=2e-5, atol=2e-5, err_msg=k,
+        )
+
+
+def test_ep_remat_train_step_on_mesh(tiny):
+    cfg, _, ids, targets = tiny
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "ep"))
+    step_p, init_p = make_moe_train_step(cfg, mesh)
+    step_r, init_r = make_moe_train_step(cfg, mesh, remat=True)
+    _, loss_p = step_p(init_p(jax.random.PRNGKey(9)), ids, targets)
+    _, loss_r = step_r(init_r(jax.random.PRNGKey(9)), ids, targets)
+    assert abs(float(loss_p) - float(loss_r)) < 1e-5
