@@ -131,6 +131,11 @@ def cmd_execute(args) -> int:
               "detected, not configured; drop --slices (use `schedule "
               "--slices N` for modeled multislice runs)", file=sys.stderr)
         return 2
+    if cfg.weights and not cfg.model.startswith("gpt2"):
+        # fail fast, before graph build / device binding / scheduling
+        print("--weights supports the gpt2 family (the HF name map "
+              "in frontend/pretrained.py)", file=sys.stderr)
+        return 2
     dag = cfg.build_graph()
     if not hasattr(dag, "graph"):
         print("execute needs a model DAG (gpt2* / llama* / mixtral*); "
@@ -139,7 +144,28 @@ def cmd_execute(args) -> int:
     cluster = cfg.build_cluster_with_devices()
     schedule = cfg.build_scheduler().schedule(dag.graph, cluster)
     backend = DeviceBackend(cluster)
-    params = dag.init_params()
+    if cfg.weights:
+        import torch
+
+        from .frontend.pretrained import (
+            fit_params_to_dag,
+            gpt2_params_from_state_dict,
+        )
+
+        try:
+            sd = torch.load(
+                cfg.weights, map_location="cpu", weights_only=True
+            )
+            params = fit_params_to_dag(
+                dag, gpt2_params_from_state_dict(sd, dag.config)
+            )
+        except (OSError, ValueError, RuntimeError) as e:
+            print(f"--weights {cfg.weights}: {e}", file=sys.stderr)
+            return 2
+        print(f"loaded {len(params)} params from {cfg.weights}",
+              file=sys.stderr)
+    else:
+        params = dag.init_params()
     ids = dag.make_inputs()
     rep = backend.execute(dag.graph, schedule, params, ids, profile=args.profile)
     print(json.dumps(rep.summary(), indent=1, default=str))
@@ -237,6 +263,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("execute", help="run a scheduled DAG on live devices")
     _add_common(p)
     p.add_argument("--profile", action="store_true")
+    p.add_argument("--weights", default=None,
+                   help="torch state-dict file with pretrained GPT-2 "
+                        "weights (HF layout); random init when omitted")
     p.set_defaults(fn=cmd_execute)
 
     p = sub.add_parser("visualize", help="render DAG + Gantt PNGs")

@@ -47,6 +47,10 @@ class RunConfig:
     # io
     out_dir: str = "evaluation_results"
     seed: int = 0
+    # optional pretrained weights for `execute`: a torch state-dict file
+    # (GPT-2 family; frontend/pretrained.py name-maps it) — random init
+    # when unset
+    weights: Optional[str] = None
 
     def _model_family(self):
         """(variants, layers_field, max_seq_field, builder) for real model
