@@ -11,8 +11,10 @@ scheduling → execution → evaluation/visualization), rebuilt TPU-first:
 * the reference's simulated executor survives as a pluggable CPU-runnable
   backend next to the real device backend;
 * plus native-scale subsystems the reference lacks: sharded training
-  (DP/FSDP/TP/SP), ring attention for long context, Pallas kernels,
-  checkpointing, config/CLI.
+  (DP/TP/SP/EP, remat, scanned layers), ring + Ulysses attention for long
+  context, multi-slice ICI/DCN topologies, Pallas kernels, pretrained
+  checkpoint ingestion, checkpointing, config/CLI, and a native C++
+  scheduling engine with bit-identical policies.
 
 See SURVEY.md for the layer map and parity notes.
 """
@@ -42,7 +44,11 @@ from .core.cluster import Cluster, DeviceState, estimate_cluster_memory_needed
 from .core.fusion import fuse_linear_chains
 from .core.schedule import Schedule, TaskTiming
 from .core.validate import ValidationReport, validate_schedule
+from .backends.sim import LinkModel, SimulatedBackend, TieredLinkModel
 from .sched.base import BaseScheduler
+from .sched.heft import HEFTScheduler
+from .sched.pack import GroupPackScheduler
+from .sched.pipeline import PipelineStageScheduler
 from .sched.policies import (
     ALL_SCHEDULERS,
     CriticalPathScheduler,
@@ -76,5 +82,11 @@ __all__ = [
     "GreedyScheduler",
     "CriticalPathScheduler",
     "MRUScheduler",
+    "HEFTScheduler",
+    "PipelineStageScheduler",
+    "GroupPackScheduler",
     "get_scheduler",
+    "LinkModel",
+    "TieredLinkModel",
+    "SimulatedBackend",
 ]
