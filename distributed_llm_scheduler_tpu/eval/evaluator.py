@@ -6,8 +6,10 @@ memory regimes × runs × schedulers, metric aggregation to CSV, a 4-panel
 PNG figure, and console summaries (best scheduler per metric, LLM
 cache-hit-rate table).  Differences: seedable, errors surface as recorded
 zero-rows *with* a warning (the reference silently prints and continues),
-and the backend is pluggable (simulated reference-parity, simulated full
-fidelity, or the real device backend).
+and the backend is pluggable between the two simulated fidelities
+(reference-parity and full).  Sweeps are simulation-only by design: the
+synthetic workload families carry no executable fns — real-device
+execution goes through ``bench.py`` / the ``execute`` CLI instead.
 """
 
 from __future__ import annotations
