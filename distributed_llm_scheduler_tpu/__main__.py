@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
 
@@ -209,6 +210,12 @@ def cmd_train(args) -> int:
         mcfg, mesh, remat=args.remat, scan=args.scan
     )
     state = init_state(jax.random.PRNGKey(args.seed))
+    if args.ckpt and os.path.exists(args.ckpt):
+        from .utils.checkpoint import load_state
+
+        state = load_state(args.ckpt, state)
+        print(f"resumed from {args.ckpt} at step {int(state.step)}",
+              file=sys.stderr)
     batch = max(2 * axes["dp"], 2)
     seq = min(args.seq_len, mcfg.n_positions)
     ids = jax.random.randint(
@@ -218,6 +225,10 @@ def cmd_train(args) -> int:
     for step in range(args.steps):
         state, loss = train_step(state, ids, targets)
         print(f"step {int(state.step)}: loss {float(loss):.4f}")
+    if args.ckpt:
+        from .utils.checkpoint import save_state
+
+        print(f"saved {save_state(state, args.ckpt)}", file=sys.stderr)
     return 0
 
 
@@ -285,6 +296,10 @@ def main(argv=None) -> int:
     p.add_argument("--scan", action="store_true",
                    help="scan over stacked layers (lax.scan): one compiled "
                         "block regardless of depth")
+    p.add_argument("--ckpt", default=None,
+                   help="checkpoint directory: resumed from if it exists, "
+                        "written (params + optimizer state + step) at the "
+                        "end of the run")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")

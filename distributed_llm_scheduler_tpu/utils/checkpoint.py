@@ -45,3 +45,74 @@ def load_params(path: str, use_orbax: Optional[bool] = None) -> Dict[str, Any]:
 
     with np.load(path) as f:
         return {k: f[k] for k in f.files}
+
+
+def save_state(state: Any, path: str) -> str:
+    """Save a full training state (params + optimizer moments + step) —
+    any pytree, e.g. ``parallel.train.TrainState``.  Same orbax path as
+    :func:`save_params` (which accepts any pytree)."""
+    return save_params(state, path, use_orbax=True)
+
+
+def load_state(path: str, target: Any) -> Any:
+    """Restore a training state saved by :func:`save_state`.
+
+    ``target`` is a freshly-initialized state of the same structure (e.g.
+    ``init_state(key)``): it supplies the pytree layout, and every
+    restored leaf is ``device_put`` onto the corresponding target leaf's
+    sharding, so a resumed run places arrays exactly where the mesh wants
+    them regardless of how orbax materialized them.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    path = os.path.abspath(path)
+    try:
+        restored = ckptr.restore(path, item=target)
+    except TypeError as e:
+        # No item= on this orbax version.  A raw restore() returns dicts
+        # whose sorted-key flattening order differs from the dataclass's
+        # field order — blind unflattening would assign optimizer moments
+        # into param slots (adam mu/nu mirror param shapes, so even a
+        # shape check can't catch it).  Fail loudly instead.
+        raise RuntimeError(
+            "this orbax version's restore() does not accept a target "
+            "pytree; refusing a structure-blind restore (silent leaf "
+            "reordering corrupts the state)"
+        ) from e
+    # orbax can silently fill a differently-shaped target; a wrong-config
+    # resume must fail loudly, not train on misrestored weights
+    t_leaves = jax.tree_util.tree_leaves_with_path(target)
+    r_leaves = jax.tree_util.tree_leaves_with_path(restored)
+    if len(t_leaves) != len(r_leaves):
+        raise ValueError(
+            f"checkpoint at {path} has {len(r_leaves)} leaves; the target "
+            f"state has {len(t_leaves)} — wrong model/optimizer config?"
+        )
+    for (kp, t), (_, r) in zip(t_leaves, r_leaves):
+        t_shape = tuple(getattr(t, "shape", ()))
+        r_shape = tuple(getattr(r, "shape", ()))
+        if t_shape != r_shape:
+            name = jax.tree_util.keystr(kp)
+            raise ValueError(
+                f"checkpoint leaf {name} has shape {r_shape}; target "
+                f"expects {t_shape} — wrong model config?"
+            )
+
+    # orbax may materialize leaves as host arrays; place each onto the
+    # target leaf's MESH sharding so the resumed state is laid out exactly
+    # as a fresh init would be (replicated host arrays would otherwise
+    # defeat the sharding — or OOM — on real hardware).  Leaves without a
+    # NamedSharding (e.g. optimizer counts, which a fresh init leaves
+    # uncommitted) stay as restored: committing them to one device would
+    # conflict with the mesh-sharded leaves inside jit.
+    from jax.sharding import NamedSharding
+
+    def _place(t, r):
+        sharding = getattr(t, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(r, sharding)
+        return r
+
+    return jax.tree_util.tree_map(_place, target, restored)

@@ -1,0 +1,65 @@
+"""Full training-state checkpoint/resume (params + optimizer moments +
+step) on the sharded mesh: a resumed run must be bit-identical to an
+uninterrupted one — Adam moments included, or losses drift silently."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+from distributed_llm_scheduler_tpu.parallel.mesh import make_mesh
+from distributed_llm_scheduler_tpu.parallel.train import make_train_step
+from distributed_llm_scheduler_tpu.utils.checkpoint import (
+    load_state,
+    save_state,
+)
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    cfg = GPT2Config.tiny()
+    mesh = make_mesh(dp=2, tp=4)
+    step, init = make_train_step(cfg, mesh)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    tgt = jnp.roll(ids, -1, axis=1)
+
+    # 2 steps, save, resume, 2 more
+    state = init(jax.random.PRNGKey(0))
+    for _ in range(2):
+        state, _ = step(state, ids, tgt)
+    path = str(tmp_path / "ckpt")
+    save_state(state, path)
+    resumed = load_state(path, init(jax.random.PRNGKey(0)))
+    assert int(resumed.step) == 2
+    losses_resumed = []
+    for _ in range(2):
+        resumed, loss = step(resumed, ids, tgt)
+        losses_resumed.append(float(loss))
+
+    # uninterrupted 4 steps from the same init
+    ref = init(jax.random.PRNGKey(0))
+    losses_ref = []
+    for _ in range(4):
+        ref, loss = step(ref, ids, tgt)
+        losses_ref.append(float(loss))
+
+    np.testing.assert_allclose(losses_resumed, losses_ref[2:], rtol=0, atol=0)
+    # params sharded after restore (target supplied the shardings)
+    assert len(resumed.params["h0_attn_qkv_w"].addressable_shards) == 8
+
+
+def test_load_state_requires_matching_target(tmp_path):
+    cfg = GPT2Config.tiny()
+    mesh = make_mesh(dp=2, tp=4)
+    _, init = make_train_step(cfg, mesh)
+    state = init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    save_state(state, path)
+    other_cfg = GPT2Config(
+        vocab_size=512, n_positions=128, n_embd=128, n_layer=1, n_head=4
+    )
+    _, other_init = make_train_step(other_cfg, mesh)
+    with pytest.raises(Exception):
+        load_state(path, other_init(jax.random.PRNGKey(0)))
