@@ -203,7 +203,14 @@ def cmd_train(args) -> int:
 
     cfg_map = {"gpt2": GPT2Config.small, "gpt2-medium": GPT2Config.medium,
                "gpt2-tiny": GPT2Config.tiny}
-    mcfg = cfg_map.get(args.model, GPT2Config.tiny)()
+    if args.model not in cfg_map:
+        # silently training a default GPT-2 when asked for llama would be
+        # worse than refusing
+        print(f"train supports {sorted(cfg_map)} (the sharded train step "
+              "is GPT-2-family; llama/mixtral train via the task-graph "
+              "path: --train-step on schedule/execute)", file=sys.stderr)
+        return 2
+    mcfg = cfg_map[args.model]()
     axes = factorize_mesh(len(jax.devices()))
     mesh = make_mesh(**axes)
     train_step, init_state = make_train_step(

@@ -230,17 +230,88 @@ def forward(
 ) -> jax.Array:
     """``remat=True`` checkpoints each block (HBM for FLOPs), as in
     :func:`..gpt2.forward`."""
+    return backbone_forward(
+        params, input_ids, config, transformer_block, _BLOCK_KEYS,
+        remat=remat,
+    )
+
+
+_LAYER_PREFIX_RE = None  # compiled lazily (module import stays light)
+
+
+def stack_layers(
+    params: Dict[str, jax.Array], n_layers: int, keys: Tuple[str, ...]
+) -> Dict[str, jax.Array]:
+    """Per-layer ``l{i}_*`` tensors -> stacked ``layers_*`` with a leading
+    layer dim (non-layer params unchanged) — the scanned-forward layout.
+    Shared by the Llama-backbone families (Mixtral reuses it)."""
+    import re
+
+    global _LAYER_PREFIX_RE
+    if _LAYER_PREFIX_RE is None:
+        _LAYER_PREFIX_RE = re.compile(r"^l\d+_")
+    out = {k: v for k, v in params.items() if not _LAYER_PREFIX_RE.match(k)}
+    for key in keys:
+        out["layers_" + key] = jnp.stack(
+            [params[f"l{i}_{key}"] for i in range(n_layers)]
+        )
+    return out
+
+
+def backbone_forward(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: Any,
+    block_fn: Any,
+    layer_keys: Tuple[str, ...],
+    remat: bool = False,
+    scan: bool = False,
+) -> jax.Array:
+    """The one Llama-backbone forward skeleton: embed -> n_layers x block
+    -> final RMSNorm -> LM head.  Parameterized by the layer block so
+    Llama, Mixtral (per-expert AND stacked-EP layouts), and their scanned
+    variants all share it instead of drifting.  ``scan=True`` expects
+    stacked ``layers_*`` params (:func:`stack_layers`) and runs the block
+    under ``lax.scan`` — traced/compiled once regardless of depth;
+    ``remat=True`` checkpoints the block either way.
+    """
     block = (
-        jax.checkpoint(transformer_block, static_argnums=(2,))
-        if remat
-        else transformer_block
+        jax.checkpoint(block_fn, static_argnums=(2,)) if remat else block_fn
     )
     x = embedding(input_ids, params["tok_emb"])
-    for i in range(config.n_layers):
-        p = f"l{i}_"
-        x = block({k: params[p + k] for k in _BLOCK_KEYS}, x, config)
+    if scan:
+        stacked = {k: params["layers_" + k] for k in layer_keys}
+
+        def step(h, layer_params):
+            return block(layer_params, h, config), None
+
+        x, _ = jax.lax.scan(step, x, stacked)
+    else:
+        for i in range(config.n_layers):
+            p = f"l{i}_"
+            x = block({k: params[p + k] for k in layer_keys}, x, config)
     x = rms_norm(x, params["final_norm_g"], config.rms_eps)
     return lm_head(x, params["lm_head"])
+
+
+def stack_layer_params(
+    params: Dict[str, jax.Array], config: LlamaConfig
+) -> Dict[str, jax.Array]:
+    return stack_layers(params, config.n_layers, _BLOCK_KEYS)
+
+
+def forward_scan(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: LlamaConfig,
+    remat: bool = False,
+) -> jax.Array:
+    """Forward over stacked layer params (cf. :func:`..gpt2.forward_scan`);
+    matches :func:`forward` numerically."""
+    return backbone_forward(
+        params, input_ids, config, transformer_block, _BLOCK_KEYS,
+        remat=remat, scan=True,
+    )
 
 
 def loss_fn(
@@ -249,8 +320,10 @@ def loss_fn(
     targets: jax.Array,
     config: LlamaConfig,
     remat: bool = False,
+    scan: bool = False,
 ) -> jax.Array:
-    logits = forward(params, input_ids, config, remat=remat)
+    fwd = forward_scan if scan else forward
+    logits = fwd(params, input_ids, config, remat=remat)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()

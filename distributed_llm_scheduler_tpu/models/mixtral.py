@@ -231,20 +231,18 @@ def forward_with_block(
     block_fn: Any,
     layer_keys: Tuple[str, ...],
     remat: bool = False,
+    scan: bool = False,
 ) -> jax.Array:
-    """The one Mixtral forward skeleton: embed -> n_layers x block ->
-    final norm -> LM head.  Parameterized by the layer block so the
-    per-expert path (:func:`forward`) and the stacked EP path
-    (``parallel/expert.forward_ep``) share it instead of drifting."""
-    block = (
-        jax.checkpoint(block_fn, static_argnums=(2,)) if remat else block_fn
+    """Mixtral's forward skeleton IS the Llama backbone's
+    (:func:`..llama.backbone_forward`): embed -> n_layers x block -> final
+    norm -> LM head, parameterized by the layer block so the per-expert
+    path (:func:`forward`), the stacked EP path
+    (``parallel/expert.forward_ep``), and the scanned variants all share
+    one implementation."""
+    return _llama.backbone_forward(
+        params, input_ids, config, block_fn, layer_keys,
+        remat=remat, scan=scan,
     )
-    x = embedding(input_ids, params["tok_emb"])
-    for i in range(config.n_layers):
-        p = f"l{i}_"
-        x = block({k: params[p + k] for k in layer_keys}, x, config)
-    x = rms_norm(x, params["final_norm_g"], config.rms_eps)
-    return lm_head(x, params["lm_head"])
 
 
 def nll_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -269,11 +267,35 @@ def forward(
     )
 
 
+def stack_layer_params(
+    params: Dict[str, jax.Array], config: MixtralConfig
+) -> Dict[str, jax.Array]:
+    """Scanned-forward layout via the shared :func:`..llama.stack_layers`;
+    per-expert tensors stack to (n_layers, d, f) per expert key."""
+    return _llama.stack_layers(params, config.n_layers, _layer_keys(config))
+
+
+def forward_scan(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    remat: bool = False,
+) -> jax.Array:
+    """Forward over stacked layer params via ``lax.scan`` — one compiled
+    block regardless of depth.  Matches :func:`forward` numerically."""
+    return forward_with_block(
+        params, input_ids, config, transformer_block, _layer_keys(config),
+        remat=remat, scan=True,
+    )
+
+
 def loss_fn(
     params: Dict[str, jax.Array],
     input_ids: jax.Array,
     targets: jax.Array,
     config: MixtralConfig,
     remat: bool = False,
+    scan: bool = False,
 ) -> jax.Array:
-    return nll_loss(forward(params, input_ids, config, remat=remat), targets)
+    fwd = forward_scan if scan else forward
+    return nll_loss(fwd(params, input_ids, config, remat=remat), targets)
