@@ -8,6 +8,7 @@ Replaces the reference's four bare ``python <file>.py`` entry points
 * ``execute``   — run a scheduled model DAG on live JAX devices
 * ``visualize`` — DAG structure and Gantt renderings
 * ``train``     — a few sharded (dp x tp) training steps
+* ``generate``  — autoregressive KV-cache decoding (any model family)
 * ``bench``     — the north-star benchmark (one JSON line)
 """
 
@@ -53,6 +54,23 @@ def _config_from(args: argparse.Namespace):
     fields = {f.name for f in dataclasses.fields(RunConfig)}
     kw = {k: v for k, v in vars(args).items() if k in fields and v is not None}
     return RunConfig(**kw)
+
+
+def _load_gpt2_weights(path: str, config):
+    """torch state-dict file -> flat param dict, or None after printing the
+    error (shared by ``execute --weights`` and ``generate --weights``)."""
+    import torch
+
+    from .frontend.pretrained import gpt2_params_from_state_dict
+
+    try:
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        params = gpt2_params_from_state_dict(sd, config)
+    except (OSError, ValueError, RuntimeError) as e:
+        print(f"--weights {path}: {e}", file=sys.stderr)
+        return None
+    print(f"loaded {len(params)} params from {path}", file=sys.stderr)
+    return params
 
 
 def _replay_backend(cfg):
@@ -146,25 +164,16 @@ def cmd_execute(args) -> int:
     schedule = cfg.build_scheduler().schedule(dag.graph, cluster)
     backend = DeviceBackend(cluster)
     if cfg.weights:
-        import torch
+        from .frontend.pretrained import fit_params_to_dag
 
-        from .frontend.pretrained import (
-            fit_params_to_dag,
-            gpt2_params_from_state_dict,
-        )
-
+        params = _load_gpt2_weights(cfg.weights, dag.config)
+        if params is None:
+            return 2
         try:
-            sd = torch.load(
-                cfg.weights, map_location="cpu", weights_only=True
-            )
-            params = fit_params_to_dag(
-                dag, gpt2_params_from_state_dict(sd, dag.config)
-            )
-        except (OSError, ValueError, RuntimeError) as e:
+            params = fit_params_to_dag(dag, params)
+        except ValueError as e:
             print(f"--weights {cfg.weights}: {e}", file=sys.stderr)
             return 2
-        print(f"loaded {len(params)} params from {cfg.weights}",
-              file=sys.stderr)
     else:
         params = dag.init_params()
     ids = dag.make_inputs()
@@ -239,6 +248,68 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_generate(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from .models import gpt2, llama, mixtral
+
+    cfg_map = {
+        "gpt2": (gpt2, gpt2.GPT2Config.small),
+        "gpt2-medium": (gpt2, gpt2.GPT2Config.medium),
+        "gpt2-tiny": (gpt2, gpt2.GPT2Config.tiny),
+        "llama-8b": (llama, llama.LlamaConfig.llama3_8b),
+        "llama-tiny": (llama, llama.LlamaConfig.tiny),
+        "mixtral-8x7b": (mixtral, mixtral.MixtralConfig.mixtral_8x7b),
+        "mixtral-tiny": (mixtral, mixtral.MixtralConfig.tiny),
+    }
+    if args.model not in cfg_map:
+        print(f"generate supports {sorted(cfg_map)}", file=sys.stderr)
+        return 2
+    mod, mk = cfg_map[args.model]
+    config = mk()
+
+    if args.weights:
+        if not args.model.startswith("gpt2"):
+            print("--weights supports the gpt2 family (the HF name map in "
+                  "frontend/pretrained.py)", file=sys.stderr)
+            return 2
+        params = _load_gpt2_weights(args.weights, config)
+        if params is None:
+            return 2
+    else:
+        params = mod.init_params(config, jax.random.PRNGKey(args.seed))
+
+    try:
+        prompt = [int(t) for t in args.prompt_ids.split(",") if t.strip()]
+    except ValueError:
+        print(f"--prompt-ids must be comma-separated token ids, got "
+              f"{args.prompt_ids!r}", file=sys.stderr)
+        return 2
+    if not prompt or any(t < 0 or t >= config.vocab_size for t in prompt):
+        print(f"prompt ids must be in [0, {config.vocab_size})", file=sys.stderr)
+        return 2
+    ids = jnp.asarray([prompt], dtype=jnp.int32)
+
+    try:
+        out = mod.generate(
+            params, ids, config, max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature, top_k=args.top_k,
+            key=jax.random.PRNGKey(args.seed),
+        )
+    except ValueError as e:  # e.g. past the model's position limit
+        print(str(e), file=sys.stderr)
+        return 2
+    new = [int(t) for t in out[0, len(prompt):]]
+    print(json.dumps({
+        "model": args.model,
+        "prompt_ids": prompt,
+        "generated_ids": new,
+        "temperature": args.temperature,
+    }))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import importlib.util
     import os
@@ -308,6 +379,24 @@ def main(argv=None) -> int:
                         "written (params + optimizer state + step) at the "
                         "end of the run")
     p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser(
+        "generate", help="autoregressive KV-cache decoding (one JSON line)"
+    )
+    p.add_argument("--model", default="gpt2-tiny",
+                   help="gpt2[-medium|-tiny] | llama-8b|-tiny | "
+                        "mixtral-8x7b|-tiny")
+    p.add_argument("--prompt-ids", default="1,2,3", dest="prompt_ids",
+                   help="comma-separated prompt token ids")
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=0, dest="top_k")
+    p.add_argument("--weights", default=None,
+                   help="torch state-dict file with pretrained GPT-2 "
+                        "weights (HF layout); random init when omitted")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_generate)
 
     p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")
     p.set_defaults(fn=cmd_bench)

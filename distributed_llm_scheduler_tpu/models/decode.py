@@ -1,0 +1,222 @@
+"""Autoregressive KV-cache decoding shared by all model families.
+
+The reference never *runs* a model (its forward pass is a simulated DAG
+replay, reference ``simulation.py:216-278``), so it has no inference story
+beyond "the DAG was scheduled".  The rebuild executes for real, and real
+inference means token-by-token decoding — this module supplies the shared
+machinery: a static-shape KV cache, masked cached attention, and a
+``lax.scan`` generation loop with greedy/temperature sampling.
+
+TPU notes (why the design looks like this):
+
+- **Static shapes only.** The cache is allocated at ``max_len`` up front and
+  every decode step attends over the full ``(B, H, 1, max_len)`` score
+  matrix with a position mask — no growing tensors, so XLA compiles the
+  step exactly once and `lax.scan` drives the whole generation as ONE
+  compiled program (no per-token dispatch from Python).
+- **Traced positions.** ``pos`` is an int32 scalar carried through the scan;
+  cache writes use ``lax.dynamic_update_slice`` and RoPE/wpe lookups use
+  ``lax.dynamic_slice``, both of which accept traced starts — nothing
+  recompiles as generation advances.
+- **Decode is bandwidth-bound, not MXU-bound** (one token's GEMVs), so the
+  cached-attention path uses plain XLA einsums; the Pallas flash kernel
+  (``ops/attention.py``) stays on the prefill/training path where the
+  O(T^2) score matrix actually matters.
+
+Each family module (``gpt2``, ``llama``, ``mixtral``) provides
+``init_cache(config, batch, max_len)`` and
+``forward_cached(params, ids, cache, pos_start, config)``; this module's
+:func:`generate` drives any of them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+KVCache = Dict[str, jax.Array]  # {"k": (L, B, Hkv, M, hd), "v": same}
+
+
+def init_cache(
+    n_layers: int,
+    batch: int,
+    n_kv_heads: int,
+    max_len: int,
+    head_dim: int,
+    dtype: Any,
+) -> KVCache:
+    """Zeroed stacked-layer cache; positions >= the write cursor are masked
+    out by :func:`cached_attention`, so zeros never leak into outputs."""
+    shape = (n_layers, batch, n_kv_heads, max_len, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def update_layer_cache(
+    cache: KVCache, layer: int, k_new: jax.Array, v_new: jax.Array,
+    pos_start: jax.Array
+) -> KVCache:
+    """Write (B, Hkv, T_new, hd) keys/values at [pos_start, pos_start+T_new)
+    of layer ``layer``.  ``pos_start`` may be traced."""
+    def put(buf, new):
+        return jax.lax.dynamic_update_slice(
+            buf, new[None].astype(buf.dtype), (layer, 0, 0, pos_start, 0)
+        )
+
+    return {"k": put(cache["k"], k_new), "v": put(cache["v"], v_new)}
+
+
+def cached_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos_start: jax.Array,
+    sm_scale: float,
+) -> jax.Array:
+    """Causal attention of ``q`` (B, Hq, T_new, hd) over a full-length cache
+    (B, Hkv, M, hd) whose rows beyond ``pos_start + T_new`` are invalid.
+
+    Query row ``r`` (absolute position ``pos_start + r``) may attend cache
+    columns ``c <= pos_start + r`` — this single mask covers both the
+    "stale tail" of the cache and causality among the new tokens, so the
+    same code path serves prefill (T_new = prompt) and decode (T_new = 1).
+    KV heads broadcast across their query group (GQA).
+    """
+    B, Hq, Tn, hd = q.shape
+    Hkv, M = k_cache.shape[1], k_cache.shape[2]
+    if Hq != Hkv:
+        group = Hq // Hkv
+        k_cache = jnp.repeat(k_cache, group, axis=1)
+        v_cache = jnp.repeat(v_cache, group, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * sm_scale
+    rows = pos_start + jax.lax.broadcasted_iota(jnp.int32, (Tn, M), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (Tn, M), 1)
+    scores = jnp.where(cols <= rows, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_cache.dtype), v_cache)
+
+
+def sample_token(
+    logits: jax.Array,
+    key: Optional[jax.Array],
+    temperature: float,
+    top_k: int = 0,
+) -> jax.Array:
+    """(B, V) logits -> (B,) int32 token ids.
+
+    ``temperature == 0`` is greedy argmax (no key needed).  ``top_k > 0``
+    restricts sampling to the k most likely tokens (static k, so the
+    lax.top_k shape is fixed under jit).
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    assert key is not None, "temperature sampling needs a PRNG key"
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _position_limit(config: Any) -> Optional[int]:
+    """The family's maximum absolute position: GPT-2's learned table length
+    or the Llama-backbone's trained RoPE horizon."""
+    return getattr(config, "n_positions", None) or getattr(
+        config, "max_seq_len", None
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_run(
+    forward_cached: Callable[..., Tuple[jax.Array, KVCache]],
+    init_cache_fn: Callable[[Any, int, int], KVCache],
+    config: Any,
+    B: int,
+    T: int,
+    M: int,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+):
+    """One compiled generation program per static configuration — repeated
+    generate() calls with the same shapes reuse it instead of re-tracing
+    (config is a frozen dataclass, so it hashes by value)."""
+
+    @jax.jit
+    def run(params, prompt_ids, key):
+        cache = init_cache_fn(config, B, M)
+        logits, cache = forward_cached(params, prompt_ids, cache, 0, config)
+        key, sub = jax.random.split(key)
+        first = sample_token(logits[:, -1, :], sub, temperature, top_k)
+
+        def step(carry, _):
+            cache, tok, pos, key = carry
+            logits, cache = forward_cached(
+                params, tok[:, None], cache, pos, config
+            )
+            key, sub = jax.random.split(key)
+            nxt = sample_token(logits[:, -1, :], sub, temperature, top_k)
+            return (cache, nxt, pos + 1, key), tok
+
+        (_, last, _, _), toks = jax.lax.scan(
+            step,
+            (cache, first, jnp.int32(T), key),
+            None,
+            length=max_new_tokens - 1,
+        ) if max_new_tokens > 1 else ((cache, first, None, key), None)
+        new = (
+            jnp.concatenate([toks.T, last[:, None]], axis=1)
+            if toks is not None
+            else last[:, None]
+        )
+        return jnp.concatenate([prompt_ids, new], axis=1)
+
+    return run
+
+
+def generate(
+    forward_cached: Callable[..., Tuple[jax.Array, KVCache]],
+    init_cache_fn: Callable[[Any, int, int], KVCache],
+    params: Dict[str, jax.Array],
+    prompt_ids: jax.Array,
+    config: Any,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Prefill the prompt, then scan ``max_new_tokens`` decode steps.
+
+    Returns (B, prompt_len + max_new_tokens) int32: prompt + generated.
+    The whole loop is one jitted program — prefill compiles once for the
+    prompt shape, the decode step compiles once and is iterated by
+    ``lax.scan`` on device — and the compiled program is cached per static
+    configuration, so repeated calls don't re-trace.
+    """
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt_ids
+    B, T = prompt_ids.shape
+    M = max_len if max_len is not None else T + max_new_tokens
+    assert M >= T + max_new_tokens, (
+        f"max_len {M} < prompt {T} + new {max_new_tokens}"
+    )
+    limit = _position_limit(config)
+    if limit is not None and T + max_new_tokens > limit:
+        # past the position table/RoPE horizon, dynamic_slice would CLAMP
+        # its start and silently repeat the last position's embedding
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model's position limit {limit}"
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    run = _compiled_run(
+        forward_cached, init_cache_fn, config, B, T, M, max_new_tokens,
+        float(temperature), int(top_k),
+    )
+    return run(params, prompt_ids, key)

@@ -309,3 +309,83 @@ def loss_fn(
 
 def num_params(config: GPT2Config) -> int:
     return sum(math.prod(shape) for shape, _ in param_shapes(config).values())
+
+
+# -- KV-cache decoding (models/decode.py drives this) ------------------------
+
+def init_cache(config: GPT2Config, batch: int, max_len: int):
+    from . import decode
+
+    return decode.init_cache(
+        config.n_layer, batch, config.n_head, max_len,
+        config.head_dim, config.dtype,
+    )
+
+
+def forward_cached(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    cache,
+    pos_start,
+    config: GPT2Config,
+) -> Tuple[jax.Array, Any]:
+    """Forward over ``input_ids`` occupying absolute positions
+    [pos_start, pos_start + T), reading and writing the KV cache.
+
+    One code path serves prefill (T = prompt length, pos_start = 0) and
+    decode (T = 1); ``pos_start`` may be a traced int32 scalar.  Matches
+    :func:`forward` exactly when the cache holds the full history
+    (``tests/test_decode.py`` pins logits parity and greedy-token parity).
+    """
+    from . import decode
+
+    B, T = input_ids.shape
+    pos_start = jnp.asarray(pos_start, jnp.int32)
+    nh, hd = config.n_head, config.head_dim
+    scale = 1.0 / math.sqrt(hd)
+
+    wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos_start, T, axis=0)
+    x = params["wte"][input_ids] + wpe
+    for i in range(config.n_layer):
+        p = f"h{i}_"
+        ln1 = layer_norm(x, params[p + "ln1_g"], params[p + "ln1_b"], config.ln_eps)
+        qkv = ln1 @ params[p + "attn_qkv_w"] + params[p + "attn_qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        cache = decode.update_layer_cache(cache, i, k, v, pos_start)
+        att = decode.cached_attention(
+            q, cache["k"][i], cache["v"][i], pos_start, scale
+        )
+        att = att.transpose(0, 2, 1, 3).reshape(B, T, config.n_embd)
+        x = x + (att @ params[p + "attn_proj_w"] + params[p + "attn_proj_b"])
+        ln2 = layer_norm(x, params[p + "ln2_g"], params[p + "ln2_b"], config.ln_eps)
+        h = ffn_contract(
+            ffn_activation(
+                ffn_expand(ln2, params[p + "mlp_fc_w"], params[p + "mlp_fc_b"])
+            ),
+            params[p + "mlp_proj_w"],
+            params[p + "mlp_proj_b"],
+        )
+        x = x + h
+    return _head(x, params, config), cache
+
+
+def generate(
+    params: Dict[str, jax.Array],
+    prompt_ids: jax.Array,
+    config: GPT2Config,
+    max_new_tokens: int,
+    **kw,
+) -> jax.Array:
+    """Autoregressive generation (greedy by default; see
+    :func:`.decode.generate` for temperature/top-k)."""
+    from . import decode
+
+    return decode.generate(
+        forward_cached, init_cache, params, prompt_ids, config,
+        max_new_tokens, **kw,
+    )

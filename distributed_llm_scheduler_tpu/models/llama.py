@@ -314,6 +314,97 @@ def forward_scan(
     )
 
 
+# -- KV-cache decoding (models/decode.py drives this) ------------------------
+
+def init_cache(config: LlamaConfig, batch: int, max_len: int):
+    from . import decode
+
+    return decode.init_cache(
+        config.n_layers, batch, config.n_kv_heads, max_len,
+        config.head_dim, config.dtype,
+    )
+
+
+def attention_cached(
+    x: jax.Array,
+    block_params: Dict[str, jax.Array],
+    cache,
+    layer: int,
+    pos_start,
+    config: Any,
+):
+    """GQA with RoPE at absolute positions [pos_start, pos_start+T), reading
+    and writing the stacked-layer KV cache.  Shared with Mixtral (same
+    Llama-backbone attention, reference-free — the reference has no
+    attention math at all)."""
+    from . import decode
+
+    B, T, D = x.shape
+    nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+
+    q = (x @ block_params["wq"]).reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+    k = (x @ block_params["wk"]).reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+    v = (x @ block_params["wv"]).reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+
+    # RoPE at absolute positions: tables for the full cache length (static),
+    # sliced at the (possibly traced) write cursor
+    M = cache["k"].shape[3]
+    cos_all, sin_all = rope_tables(M, hd, config.rope_theta)
+    cos = jax.lax.dynamic_slice_in_dim(cos_all, pos_start, T, axis=0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_all, pos_start, T, axis=0)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    cache = decode.update_layer_cache(cache, layer, k, v, pos_start)
+    out = decode.cached_attention(
+        q, cache["k"][layer], cache["v"][layer], pos_start,
+        1.0 / math.sqrt(hd),
+    )
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
+    return out @ block_params["wo"], cache
+
+
+def forward_cached(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    cache,
+    pos_start,
+    config: LlamaConfig,
+) -> Tuple[jax.Array, Any]:
+    """Cached forward over positions [pos_start, pos_start + T); one code
+    path for prefill and decode (cf. :func:`..gpt2.forward_cached`)."""
+    pos_start = jnp.asarray(pos_start, jnp.int32)
+    x = embedding(input_ids, params["tok_emb"])
+    for i in range(config.n_layers):
+        p = f"l{i}_"
+        bp = {k: params[p + k] for k in _BLOCK_KEYS}
+        h = rms_norm(x, bp["attn_norm_g"], config.rms_eps)
+        h, cache = attention_cached(h, bp, cache, i, pos_start, config)
+        x = residual_add(x, h)
+        h = rms_norm(x, bp["ffn_norm_g"], config.rms_eps)
+        g = ffn_gate(h, bp["w_gate"])
+        u = ffn_up(h, bp["w_up"])
+        h = ffn_down(ffn_glu(g, u), bp["w_down"])
+        x = residual_add(x, h)
+    x = rms_norm(x, params["final_norm_g"], config.rms_eps)
+    return lm_head(x, params["lm_head"]), cache
+
+
+def generate(
+    params: Dict[str, jax.Array],
+    prompt_ids: jax.Array,
+    config: LlamaConfig,
+    max_new_tokens: int,
+    **kw,
+) -> jax.Array:
+    from . import decode
+
+    return decode.generate(
+        forward_cached, init_cache, params, prompt_ids, config,
+        max_new_tokens, **kw,
+    )
+
+
 def loss_fn(
     params: Dict[str, jax.Array],
     input_ids: jax.Array,

@@ -289,6 +289,59 @@ def forward_scan(
     )
 
 
+# -- KV-cache decoding (models/decode.py drives this) ------------------------
+
+def init_cache(config: MixtralConfig, batch: int, max_len: int):
+    from . import decode
+
+    return decode.init_cache(
+        config.n_layers, batch, config.n_kv_heads, max_len,
+        config.head_dim, config.dtype,
+    )
+
+
+def forward_cached(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    cache,
+    pos_start,
+    config: MixtralConfig,
+) -> Tuple[jax.Array, Any]:
+    """Cached forward over positions [pos_start, pos_start + T).  Attention
+    is the shared Llama-backbone cached path; the FFN is the same
+    router/experts/combine math as :func:`transformer_block` — routing is
+    per-token, so decode steps route each new token independently, exactly
+    as the fused forward would."""
+    pos_start = jnp.asarray(pos_start, jnp.int32)
+    keys = _layer_keys(config)
+    x = _llama.embedding(input_ids, params["tok_emb"])
+    for i in range(config.n_layers):
+        p = f"l{i}_"
+        bp = {k: params[p + k] for k in keys}
+        h = rms_norm(x, bp["attn_norm_g"], config.rms_eps)
+        h, cache = _llama.attention_cached(h, bp, cache, i, pos_start, config)
+        x = residual_add(x, h)
+        h = rms_norm(x, bp["ffn_norm_g"], config.rms_eps)
+        x = residual_add(x, _moe(bp, h, config))
+    x = rms_norm(x, params["final_norm_g"], config.rms_eps)
+    return _llama.lm_head(x, params["lm_head"]), cache
+
+
+def generate(
+    params: Dict[str, jax.Array],
+    prompt_ids: jax.Array,
+    config: MixtralConfig,
+    max_new_tokens: int,
+    **kw,
+) -> jax.Array:
+    from . import decode
+
+    return decode.generate(
+        forward_cached, init_cache, params, prompt_ids, config,
+        max_new_tokens, **kw,
+    )
+
+
 def loss_fn(
     params: Dict[str, jax.Array],
     input_ids: jax.Array,
