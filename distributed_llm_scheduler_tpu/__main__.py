@@ -142,6 +142,10 @@ def cmd_execute(args) -> int:
     from .backends.device import DeviceBackend
 
     cfg = _config_from(args)
+    if args.profile and args.segments:
+        print("--segments fuses away task boundaries; per-task --profile "
+              "timings need per-task dispatch", file=sys.stderr)
+        return 2
     if cfg.slices > 1:
         # live clusters carry their REAL slice topology (from_jax_devices
         # reads device.slice_index); an artificial --slices would silently
@@ -177,7 +181,10 @@ def cmd_execute(args) -> int:
     else:
         params = dag.init_params()
     ids = dag.make_inputs()
-    rep = backend.execute(dag.graph, schedule, params, ids, profile=args.profile)
+    rep = backend.execute(
+        dag.graph, schedule, params, ids, profile=args.profile,
+        segments=args.segments,
+    )
     print(json.dumps(rep.summary(), indent=1, default=str))
     return 0
 
@@ -253,21 +260,22 @@ def cmd_generate(args) -> int:
     import jax.numpy as jnp
 
     from .models import gpt2, llama, mixtral
+    from .utils.config import RunConfig
 
-    cfg_map = {
-        "gpt2": (gpt2, gpt2.GPT2Config.small),
-        "gpt2-medium": (gpt2, gpt2.GPT2Config.medium),
-        "gpt2-tiny": (gpt2, gpt2.GPT2Config.tiny),
-        "llama-8b": (llama, llama.LlamaConfig.llama3_8b),
-        "llama-tiny": (llama, llama.LlamaConfig.tiny),
-        "mixtral-8x7b": (mixtral, mixtral.MixtralConfig.mixtral_8x7b),
-        "mixtral-tiny": (mixtral, mixtral.MixtralConfig.tiny),
-    }
-    if args.model not in cfg_map:
-        print(f"generate supports {sorted(cfg_map)}", file=sys.stderr)
+    # same variant table as every other subcommand (utils/config.py)
+    try:
+        config = RunConfig(model=args.model).model_config()
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
         return 2
-    mod, mk = cfg_map[args.model]
-    config = mk()
+    if config is None:
+        print("generate needs a real model family (gpt2* / llama* / "
+              "mixtral*); synthetic graphs have no decode path",
+              file=sys.stderr)
+        return 2
+    mod = {
+        "g": gpt2, "l": llama, "m": mixtral,
+    }[args.model[0]]
 
     if args.weights:
         if not args.model.startswith("gpt2"):
@@ -352,6 +360,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("execute", help="run a scheduled DAG on live devices")
     _add_common(p)
     p.add_argument("--profile", action="store_true")
+    p.add_argument("--segments", action="store_true",
+                   help="fuse each device's contiguous scheduled run into "
+                        "one XLA launch (incompatible with --profile)")
     p.add_argument("--weights", default=None,
                    help="torch state-dict file with pretrained GPT-2 "
                         "weights (HF layout); random init when omitted")

@@ -62,6 +62,9 @@ class DeviceReport:
     timings: Dict[str, TaskTiming] = field(default_factory=dict)
     # per-device HBM peaks, when the platform reports memory_stats
     peak_hbm_bytes: Dict[str, int] = field(default_factory=dict)
+    # executable launches issued (== placed tasks per-task; == segments
+    # under segment fusion)
+    n_dispatches: int = 0
 
     @property
     def total_param_gb_placed(self) -> float:
@@ -76,6 +79,7 @@ class DeviceReport:
             "transfer_mb": self.transfer_bytes / 1024**2,
             "param_gb_placed": self.total_param_gb_placed,
             "compile_s": self.compile_s,
+            "n_dispatches": self.n_dispatches,
             "peak_hbm_gb": {
                 k: v / 1024**3 for k, v in self.peak_hbm_bytes.items()
             },
@@ -113,6 +117,13 @@ class DeviceBackend:
         # fn object -> jitted fn; survives across execute() calls so
         # benchmark reruns don't pay compilation again
         self._jit_cache: Dict[Any, Callable[..., Any]] = {}
+        # graph -> {(tids, exports): jitted segment fn}; weak so a dead
+        # graph releases its compiled segments
+        import weakref
+
+        self._seg_cache: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
 
     def _fence_device(self):
         """The device the end-of-run fence reads back from."""
@@ -171,6 +182,7 @@ class DeviceBackend:
         schedule: Schedule,
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
+        segments: bool = False,
     ) -> float:
         """Compile every (fn, placement-device) combination ahead of time;
         returns seconds.
@@ -180,7 +192,10 @@ class DeviceBackend:
         compilation — the analog of XLA's compile-once/run-many contract.
         """
         t0 = time.perf_counter()
-        self._run(graph, schedule, placed_params, graph_input, profile=False)
+        if segments:
+            self._run_segmented(graph, schedule, placed_params, graph_input)
+        else:
+            self._run(graph, schedule, placed_params, graph_input, profile=False)
         return time.perf_counter() - t0
 
     # -- dispatch order ----------------------------------------------------
@@ -248,6 +263,171 @@ class DeviceBackend:
             t for t in graph.topo_order if t in placement and t not in emitted
         )
         return order
+
+    # -- segment fusion ----------------------------------------------------
+    @staticmethod
+    def build_segments(
+        graph: TaskGraph, schedule: Schedule, order: List[str]
+    ) -> List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]:
+        """Partition the dispatch order into per-device segments.
+
+        A segment is a maximal run of consecutive (in dispatch order) tasks
+        placed on the same device; each becomes ONE jitted executable, so
+        XLA fuses across task boundaries and the host issues one launch per
+        segment instead of one per task — the task-batching answer to
+        SURVEY.md §7 hard-part #1 (per-task dispatch overhead swamping many
+        small tasks), applied *post-placement* so the scheduler's decisions
+        are untouched.  Segment boundaries are exactly the schedule's
+        device switches: on one chip the whole DAG is one program (the
+        fused forward, recovered automatically); a pipeline's 1F1B
+        interleaving yields one segment per microbatch-stage visit, with
+        real transfers between them.
+
+        Returns (node_id, tids, exports): ``exports`` are the tasks whose
+        outputs are consumed by later segments or by nobody (leaves —
+        kept for the end-of-run fence and the final output).
+        """
+        placement = schedule.placement
+        runs: List[Tuple[str, List[str]]] = []
+        for tid in order:
+            if tid not in placement:
+                continue
+            node = placement[tid]
+            if runs and runs[-1][0] == node:
+                runs[-1][1].append(tid)
+            else:
+                runs.append((node, [tid]))
+        consumers: Dict[str, set] = {tid: set() for tid in placement}
+        for seg_i, (_, tids) in enumerate(runs):
+            for tid in tids:
+                for d in graph[tid].arg_tasks or graph[tid].dependencies:
+                    if d in consumers:
+                        consumers[d].add(seg_i)
+        segments = []
+        for seg_i, (node, tids) in enumerate(runs):
+            exports = tuple(
+                t for t in tids
+                if consumers[t] - {seg_i} or not consumers[t]
+            )
+            segments.append((node, tuple(tids), exports))
+        return segments
+
+    def _segment_callable(self, graph: TaskGraph, tids: Tuple[str, ...],
+                          exports: Tuple[str, ...]):
+        """One jitted fn running ``tids`` in order: (params-by-global-name,
+        external-inputs-by-task-id) -> {export tid: output}.
+
+        Cached per (graph, tids, exports): the graph key (a WeakKey, so
+        dead graphs release their executables) prevents a backend reused
+        across graphs with colliding task ids from running stale fns, and
+        ``exports`` is part of the key because the same run under a
+        different downstream placement must return a different output set.
+        """
+        per_graph = self._seg_cache.setdefault(graph, {})
+        key = (tids, exports)
+        fn = per_graph.get(key)
+        if fn is not None:
+            return fn
+
+        def seg_fn(seg_params, ext):
+            vals: Dict[str, Any] = {}
+            for tid in tids:
+                task = graph[tid]
+                pd = {loc: seg_params[g] for loc, g in task.param_items()}
+                aids = task.arg_tasks or task.dependencies
+                if aids:
+                    # KeyError here = a segment-boundary bookkeeping bug;
+                    # never silently pass None into a task fn
+                    args = [vals[d] if d in vals else ext[d] for d in aids]
+                else:
+                    args = [ext["__input__"]]
+                vals[tid] = task.fn(pd, *args)
+            return {t: vals[t] for t in exports}
+
+        fn = jax.jit(seg_fn)
+        per_graph[key] = fn
+        return fn
+
+    def _run_segmented(
+        self,
+        graph: TaskGraph,
+        schedule: Schedule,
+        placed_params: Dict[Tuple[str, str], Any],
+        graph_input: Any,
+    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
+        """Segment-fused execution: same placement, one launch per segment.
+        Tasks with failed upstreams are dropped at segment-build time (host
+        side), preserving fail-and-continue.  Cross-segment inputs are
+        deduplicated per segment — a remote value consumed by several tasks
+        of one segment transfers once, so transfer counts can be LOWER than
+        per-task dispatch (an inherent win of batching, reported as
+        measured)."""
+        placement = schedule.placement
+        order = self.dispatch_order(graph, schedule)
+        # drop tasks whose (transitive) producers are unplaced/skipped —
+        # the host-side equivalent of the per-task path's upstream check
+        alive: set = set()
+        for tid in order:
+            aids = graph[tid].arg_tasks or graph[tid].dependencies
+            if all(d in alive for d in aids):
+                alive.add(tid)
+        order = [t for t in order if t in alive]
+        segments = self.build_segments(graph, schedule, order)
+
+        outputs: Dict[str, Any] = {}
+        transfer_edges = 0
+        transfer_bytes = 0
+        for node, tids, exports in segments:
+            dev = self.cluster[node].jax_device
+            union: Dict[str, Any] = {}
+            ext: Dict[str, Any] = {}
+            inside = set(tids)
+            needs_input = False
+            for tid in tids:
+                task = graph[tid]
+                for _, g in task.param_items():
+                    if g not in union:
+                        union[g] = placed_params[(g, node)]
+                aids = task.arg_tasks or task.dependencies
+                if not aids:
+                    needs_input = True
+                for d in aids:
+                    if d not in inside and d not in ext:
+                        x = outputs[d]
+                        if placement.get(d) != node:
+                            transfer_edges += 1
+                            transfer_bytes += _array_bytes(x)
+                            x = jax.device_put(x, dev)
+                        ext[d] = x
+            if needs_input:
+                ext["__input__"] = jax.device_put(graph_input, dev)
+            fn = self._segment_callable(graph, tids, exports)
+            outputs.update(fn(union, ext))
+
+        n_fences = 0
+        if outputs:
+            from ..utils.costmodel import readback_fence
+
+            jax.block_until_ready(list(outputs.values()))
+            last_on_device: Dict[str, Any] = {}
+            for node, tids, exports in segments:
+                if exports:
+                    last_on_device[node] = outputs[exports[-1]]
+            fence_dev = self._fence_device()
+            tips = []
+            for out in last_on_device.values():
+                leaf = jax.tree_util.tree_leaves(out)[-1]
+                tip = leaf[(0,) * leaf.ndim]
+                tips.append(jax.device_put(tip, fence_dev))
+            combined = tips[0]
+            for t in tips[1:]:
+                combined = combined + t.astype(combined.dtype)
+            readback_fence(combined)
+            n_fences = 1
+        # same semantics as the per-task path: None when the graph's last
+        # task didn't execute (callers detect incomplete runs by this)
+        final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
+        return final, {}, transfer_edges, transfer_bytes, n_fences, len(segments)
 
     # -- execution ---------------------------------------------------------
     def _run(
@@ -340,7 +520,7 @@ class DeviceBackend:
             readback_fence(combined)
             n_fences = 1
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
-        return final, timings, transfer_edges, transfer_bytes, n_fences
+        return final, timings, transfer_edges, transfer_bytes, n_fences, len(outputs)
 
     def execute(
         self,
@@ -350,6 +530,7 @@ class DeviceBackend:
         graph_input: Any,
         profile: bool = False,
         warmup: bool = True,
+        segments: bool = False,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
@@ -361,7 +542,18 @@ class DeviceBackend:
         ``utils/costmodel.calibrate`` picks the right method per platform.
         ``profile=False`` measures makespan ending at a single combined
         readback fence, its round-trip netted out.
+
+        ``segments=True`` fuses each device's contiguous scheduled run into
+        one XLA executable (:meth:`build_segments`): identical placement
+        and transfers, one launch per segment — the production execution
+        mode where per-task dispatch overhead would otherwise dominate
+        (e.g. hundreds of sub-ms tasks).  Incompatible with ``profile``
+        (task boundaries vanish inside the fused programs).
         """
+        if segments and profile:
+            raise ValueError(
+                "profile=True needs per-task dispatch; run without segments"
+            )
         graph.freeze()
         no_fn = [t.task_id for t in graph if t.fn is None]
         if no_fn:
@@ -376,7 +568,9 @@ class DeviceBackend:
 
         compile_s = 0.0
         if warmup:
-            compile_s = self.warmup(graph, schedule, placed, graph_input)
+            compile_s = self.warmup(
+                graph, schedule, placed, graph_input, segments=segments
+            )
 
         # fence round-trip, re-measured per execute (outside the timed
         # region): tunnel RTT demonstrably changes across reconnects, so a
@@ -387,9 +581,14 @@ class DeviceBackend:
         rtt = _fence_rtt(self._fence_device())
 
         t0 = time.perf_counter()
-        output, timings, tedges, tbytes, n_fences = self._run(
-            graph, schedule, placed, graph_input, profile
-        )
+        if segments:
+            output, timings, tedges, tbytes, n_fences, n_disp = (
+                self._run_segmented(graph, schedule, placed, graph_input)
+            )
+        else:
+            output, timings, tedges, tbytes, n_fences, n_disp = self._run(
+                graph, schedule, placed, graph_input, profile
+            )
         wall = time.perf_counter() - t0
         makespan = max(wall - n_fences * rtt, 1e-9)
 
@@ -415,4 +614,5 @@ class DeviceBackend:
             compile_s=compile_s,
             timings=timings,
             peak_hbm_bytes=peaks,
+            n_dispatches=n_disp,
         )

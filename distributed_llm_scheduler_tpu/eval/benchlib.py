@@ -287,6 +287,10 @@ class BenchResult:
     mfu_single_chip: Optional[float] = None
     dispatch_overhead: Optional[float] = None
     link_provenance: Optional[str] = None
+    # segment-fused single-chip execution (the production dispatch mode):
+    # measured makespan and its MFU
+    segmented_makespan_s: Optional[float] = None
+    mfu_segmented: Optional[float] = None
 
     @property
     def metric(self) -> str:
@@ -321,6 +325,12 @@ class BenchResult:
             out["mfu_single_chip"] = round(self.mfu_single_chip, 4)
         if self.dispatch_overhead is not None:
             out["dispatch_overhead"] = round(self.dispatch_overhead, 4)
+        if self.segmented_makespan_s is not None:
+            out["segmented_makespan_ms"] = round(
+                self.segmented_makespan_s * 1e3, 4
+            )
+        if self.mfu_segmented is not None:
+            out["mfu_segmented"] = round(self.mfu_segmented, 4)
         if self.link_provenance is not None:
             out["link"] = self.link_provenance
         return out

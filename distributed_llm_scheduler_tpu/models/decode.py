@@ -202,9 +202,11 @@ def generate(
         return prompt_ids
     B, T = prompt_ids.shape
     M = max_len if max_len is not None else T + max_new_tokens
-    assert M >= T + max_new_tokens, (
-        f"max_len {M} < prompt {T} + new {max_new_tokens}"
-    )
+    if M < T + max_new_tokens:
+        # an undersized cache would CLAMP dynamic_update_slice writes and
+        # silently corrupt generation — refuse loudly (not an assert: this
+        # must survive python -O)
+        raise ValueError(f"max_len {M} < prompt {T} + new {max_new_tokens}")
     limit = _position_limit(config)
     if limit is not None and T + max_new_tokens > limit:
         # past the position table/RoPE horizon, dynamic_slice would CLAMP

@@ -60,6 +60,26 @@ def dependency_aware_order(
       All placed task_ids ordered by simulated start time (ties broken by
       topological position).
     """
+    order, _, _ = simulate_placement(graph, placement, speeds, link, slices)
+    return order
+
+
+def simulate_placement(
+    graph: TaskGraph,
+    placement: Dict[str, str],
+    speeds: Optional[Dict[str, float]] = None,
+    link: Optional[LinkModel] = None,
+    slices: Optional[Dict[str, int]] = None,
+) -> Tuple[List[str], float, Dict[str, float]]:
+    """The event simulation behind :func:`dependency_aware_order`, with its
+    cost estimates exposed: ``(order, makespan, node_finish)``.
+
+    ``makespan`` is the max simulated finish over placed tasks and
+    ``node_finish`` each node's last finish — the objective and the
+    bottleneck signal the local-search refinement (:mod:`.refine`)
+    hill-climbs on, using exactly the cost model the ordering pass and the
+    replay charge (so the search can't optimize a different fiction).
+    """
     link = link or LinkModel()
     speeds = speeds or {}
     slices = slices or {}
@@ -164,4 +184,10 @@ def dependency_aware_order(
             dispatch(nid)
 
     placed = [tid for tid in graph.topo_order if tid in placement]
-    return sorted(placed, key=lambda t: (start_at.get(t, 0.0), topo_pos[t]))
+    order = sorted(placed, key=lambda t: (start_at.get(t, 0.0), topo_pos[t]))
+    node_finish = {nid: 0.0 for nid in ready}
+    for tid, f in finish.items():
+        nid = placement[tid]
+        node_finish[nid] = max(node_finish[nid], f)
+    makespan = max(node_finish.values(), default=0.0)
+    return order, makespan, node_finish

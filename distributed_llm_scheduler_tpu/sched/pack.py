@@ -42,8 +42,10 @@ class GroupPackScheduler(BaseScheduler):
     def __init__(self, link: Optional[LinkModel] = None):
         self.link = link or LinkModel()
 
-    def run_policy(self, run: SchedulerRun) -> None:
-        graph, devices = run.graph, run.cluster.devices
+    def plan(self, graph, devices) -> Dict[str, int]:
+        """LPT group packing: group name -> device index (unplaceable
+        groups absent).  The refinement policy (:mod:`.refine`) reuses this
+        as its search seed."""
         n_dev = len(devices)
         groups, compute, activ, gparams = _group_stats(graph)
 
@@ -74,7 +76,15 @@ class GroupPackScheduler(BaseScheduler):
             placed[groups[gi]] = best_d
             dev_params[best_d] |= gparams[gi]
             dev_act[best_d] = max(dev_act[best_d], activ[gi])
+        return placed
 
+    def run_policy(self, run: SchedulerRun) -> None:
+        self.commit(run, self.plan(run.graph, run.cluster.devices))
+
+    def commit(self, run: SchedulerRun, placed: Dict[str, int]) -> None:
+        """Assign tasks per the group placement, then order execution with
+        the dependency-aware event simulation."""
+        graph, devices = run.graph, run.cluster.devices
         for tid in graph.topo_order:
             task = graph[tid]
             if tid not in run.pending:

@@ -95,6 +95,24 @@ class RunConfig:
             )
         return None
 
+    def model_config(self):
+        """Model config instance for a real-family variant name.
+
+        The ONE variant-name lookup (CLI generate and build_graph share
+        it): returns None for synthetic workloads, raises ValueError for an
+        unknown variant of a known family."""
+        family = self._model_family()
+        if family is None:
+            return None
+        variants = family[0]
+        maker = variants.get(self.model)
+        if maker is None:
+            raise ValueError(
+                f"unknown model {self.model!r}; variants are "
+                f"{' / '.join(sorted(variants))}"
+            )
+        return maker()
+
     def build_graph(self):
         from ..frontend import generators
 
@@ -116,13 +134,7 @@ class RunConfig:
         family = self._model_family()
         if family is not None:
             variants, layers_field, max_seq_field, builder = family
-            maker = variants.get(self.model)
-            if maker is None:
-                raise ValueError(
-                    f"unknown model {self.model!r}; variants are "
-                    f"{' / '.join(sorted(variants))}"
-                )
-            cfg = maker()
+            cfg = self.model_config()
             if self.num_layers:
                 cfg = dataclasses.replace(cfg, **{layers_field: self.num_layers})
             seq = min(self.seq_len, getattr(cfg, max_seq_field))

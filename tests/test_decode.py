@@ -121,7 +121,7 @@ def test_top_k_one_is_greedy():
 
 def test_max_len_validation():
     mod, config, params, ids = _setup("gpt2", batch=1, T=4)
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError, match="max_len"):
         mod.generate(params, ids, config, max_new_tokens=8, max_len=6)
 
 

@@ -1,0 +1,148 @@
+"""Segment-fused device execution: one launch per device-contiguous run.
+
+Pins the contract of ``DeviceBackend.execute(segments=True)``: identical
+outputs and transfer accounting to per-task dispatch, with the launch count
+collapsing from O(tasks) to O(device switches) — the task-batching answer
+to SURVEY.md §7 hard-part #1 (dispatch overhead swamping many small tasks).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import (
+    Cluster,
+    DeviceState,
+    Task,
+    TaskGraph,
+    get_scheduler,
+)
+from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+from distributed_llm_scheduler_tpu.core.schedule import Schedule
+from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+
+@pytest.fixture(scope="module")
+def mesh_cluster():
+    assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
+    return Cluster.from_jax_devices(hbm_cap_gb=4.0)
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=16)
+    return dag, dag.init_params(), dag.make_inputs()
+
+
+def test_single_device_collapses_to_one_launch(tiny_setup):
+    """On one chip the whole DAG becomes one XLA program — the fused
+    forward, recovered automatically from the placed schedule."""
+    dag, params, ids = tiny_setup
+    one = Cluster.from_jax_devices(jax.devices()[:1], hbm_cap_gb=8.0)
+    schedule = get_scheduler("greedy").schedule(dag.graph, one)
+    rep = DeviceBackend(one).execute(
+        dag.graph, schedule, params, ids, segments=True
+    )
+    assert rep.n_dispatches == 1
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("policy", ["roundrobin", "pipeline", "pack"])
+def test_segmented_matches_per_task_execution(mesh_cluster, tiny_setup, policy):
+    dag, params, ids = tiny_setup
+    schedule = get_scheduler(policy).schedule(dag.graph, mesh_cluster)
+    assert not schedule.failed
+    backend = DeviceBackend(mesh_cluster)
+    per_task = backend.execute(dag.graph, schedule, params, ids)
+    seg = backend.execute(dag.graph, schedule, params, ids, segments=True)
+    np.testing.assert_allclose(
+        np.asarray(per_task.output), np.asarray(seg.output),
+        rtol=2e-5, atol=2e-5,
+    )
+    assert seg.n_dispatches <= per_task.n_dispatches
+    # a remote value consumed by several tasks of one segment moves once
+    # (deduped), so segmented transfers never exceed per-task transfers
+    assert 0 < seg.transfer_edges <= per_task.transfer_edges
+    assert 0 < seg.transfer_bytes <= per_task.transfer_bytes
+
+
+def test_launch_count_is_device_switch_count(mesh_cluster, tiny_setup):
+    """Pipeline places device-contiguous stage runs, so segments (device
+    switches in dispatch order) are far fewer than tasks."""
+    dag, params, ids = tiny_setup
+    schedule = get_scheduler("pipeline").schedule(dag.graph, mesh_cluster)
+    order = DeviceBackend.dispatch_order(dag.graph, schedule)
+    placement = schedule.placement
+    switches = sum(
+        1
+        for i, t in enumerate(order)
+        if i == 0 or placement[t] != placement[order[i - 1]]
+    )
+    rep = DeviceBackend(mesh_cluster).execute(
+        dag.graph, schedule, params, ids, segments=True
+    )
+    assert rep.n_dispatches == switches
+    assert rep.n_dispatches < len(order)  # actually batched
+
+
+def test_build_segments_exports():
+    """Exports = outputs consumed by later segments, plus leaves."""
+    g = TaskGraph(name="seg")
+    fn = lambda pd, *xs: sum(xs) if xs else jnp.zeros(())
+
+    def add(tid, deps):
+        g.add_task(Task(tid, memory_required=0.0, compute_time=1e-6,
+                        dependencies=deps, fn=fn))
+
+    add("a", [])
+    add("b", ["a"])
+    add("c", ["b"])
+    add("d", ["b", "c"])
+    sched = Schedule(
+        policy="manual",
+        per_node={"n0": ["a", "b"], "n1": ["c", "d"]},
+        assignment_order=["a", "b", "c", "d"],
+    )
+    segs = DeviceBackend.build_segments(g, sched, ["a", "b", "c", "d"])
+    assert [(n, list(t)) for n, t, _ in segs] == [
+        ("n0", ["a", "b"]), ("n1", ["c", "d"])
+    ]
+    # b crosses to segment 1; a is internal; d is a leaf
+    assert segs[0][2] == ("b",)
+    assert segs[1][2] == ("d",)
+
+
+def test_segmented_skips_failed_upstreams():
+    """Fail-and-continue: a task absent from the placement drops its
+    dependents from segment execution instead of crashing."""
+    g = TaskGraph(name="fail")
+    mk = lambda: (lambda pd, *xs: (xs[0] + 1.0) if xs else jnp.zeros((2,)))
+
+    def add(tid, deps):
+        g.add_task(Task(tid, memory_required=0.0, compute_time=1e-6,
+                        dependencies=deps, fn=mk()))
+
+    add("root", [])
+    add("dead", ["root"])
+    add("child_of_dead", ["dead"])
+    add("alive", ["root"])
+    cluster = Cluster.from_jax_devices(jax.devices()[:2], hbm_cap_gb=8.0)
+    n0 = cluster.devices[0].node_id
+    sched = Schedule(  # "dead" never placed
+        policy="manual",
+        per_node={n0: ["root", "child_of_dead", "alive"]},
+        assignment_order=["root", "child_of_dead", "alive"],
+    )
+    rep = DeviceBackend(cluster).execute(
+        g, sched, {}, jnp.zeros((2,)), segments=True
+    )
+    # root+alive execute as one segment; child_of_dead is dropped with its
+    # failed parent, and — matching the per-task path — the report's
+    # output is None because the graph's final task did not run
+    assert rep.n_dispatches == 1
+    assert rep.output is None
