@@ -150,9 +150,6 @@ def measure(
         graph_flops,
         pick_best,
     )
-    from distributed_llm_scheduler_tpu.sched.heft import HEFTScheduler
-    from distributed_llm_scheduler_tpu.sched.pack import GroupPackScheduler
-    from distributed_llm_scheduler_tpu.sched.pipeline import PipelineStageScheduler
     from distributed_llm_scheduler_tpu.sched.policies import ALL_SCHEDULERS
 
     # end-to-end single-chip execution: warmed makespan, fused-oracle check,
@@ -259,14 +256,8 @@ def measure(
     schedules = {}
     for name in sorted(ALL_SCHEDULERS):
         # link-aware policies optimize the replay's objective: same link
-        if name == "heft":
-            sched = HEFTScheduler(link=link)
-        elif name == "pipeline":
-            sched = PipelineStageScheduler(link=link)
-        elif name == "pack":
-            sched = GroupPackScheduler(link=link)
-        else:
-            sched = get_scheduler(name)
+        # (get_scheduler hands `link` to any policy whose ctor accepts it)
+        sched = get_scheduler(name, link=link)
         s = sched.schedule(graph, cluster)
         r = sim.execute(graph, cluster, s, dag_type="gpt2_small")
         completion = r.completed_tasks / r.num_tasks

@@ -329,19 +329,30 @@ class DeviceBackend:
         if fn is not None:
             return fn
 
+        # extract per-task (fn, params, args) up front: the closure must
+        # NOT capture `graph`, or the cache value would strongly reference
+        # its own WeakKey and the graph could never be collected
+        steps = tuple(
+            (
+                tid,
+                graph[tid].fn,
+                tuple(graph[tid].param_items()),
+                tuple(graph[tid].arg_tasks or graph[tid].dependencies),
+            )
+            for tid in tids
+        )
+
         def seg_fn(seg_params, ext):
             vals: Dict[str, Any] = {}
-            for tid in tids:
-                task = graph[tid]
-                pd = {loc: seg_params[g] for loc, g in task.param_items()}
-                aids = task.arg_tasks or task.dependencies
+            for tid, task_fn, pitems, aids in steps:
+                pd = {loc: seg_params[g] for loc, g in pitems}
                 if aids:
                     # KeyError here = a segment-boundary bookkeeping bug;
                     # never silently pass None into a task fn
                     args = [vals[d] if d in vals else ext[d] for d in aids]
                 else:
                     args = [ext["__input__"]]
-                vals[tid] = task.fn(pd, *args)
+                vals[tid] = task_fn(pd, *args)
             return {t: vals[t] for t in exports}
 
         fn = jax.jit(seg_fn)
@@ -437,7 +448,7 @@ class DeviceBackend:
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
         profile: bool,
-    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int]:
+    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
         placement = schedule.placement
         outputs: Dict[str, Any] = {}
         timings: Dict[str, TaskTiming] = {}

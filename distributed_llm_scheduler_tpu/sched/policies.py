@@ -242,6 +242,7 @@ class MRUScheduler(BaseScheduler):
 from .heft import HEFTScheduler  # noqa: E402  (avoids a circular import)
 from .pack import GroupPackScheduler  # noqa: E402
 from .pipeline import PipelineStageScheduler  # noqa: E402
+from .refine import RefinedPackScheduler  # noqa: E402
 
 ALL_SCHEDULERS = {
     cls.name: cls
@@ -254,6 +255,7 @@ ALL_SCHEDULERS = {
         HEFTScheduler,
         PipelineStageScheduler,
         GroupPackScheduler,
+        RefinedPackScheduler,
     )
 }
 

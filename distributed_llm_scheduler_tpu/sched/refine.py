@@ -1,0 +1,160 @@
+"""Local-search refinement over group placements.
+
+LPT packing (:mod:`.pack`) balances *parameter-load* unions greedily, but
+its one-pass greedy choice is blind to two things the replay actually
+charges: dependency-wait serialization (a balanced device can still idle on
+cross-device inputs) and the interaction between load order and compute
+overlap.  This policy closes that gap with plain hill climbing:
+
+1. seed with pack's LPT group placement;
+2. repeatedly propose **moves** (bottleneck-device group -> elsewhere) and
+   **swaps** (bottleneck group <-> lighter-device group), scoring each
+   candidate with the same event simulation the ordering pass and the
+   replay use (:func:`..sched.eventsim.simulate_placement`) — the search
+   optimizes the objective it is judged on, not a proxy;
+3. first-improvement acceptance, stop when a full neighborhood pass finds
+   nothing better or the evaluation budget runs out;
+4. commit through pack's assignment path (same memory checks, same
+   dependency-aware final ordering).
+
+The reference has no search-based policy (its four schedulers are one-pass
+list schedulers, reference ``schedulers.py:138-525``); this is new
+capability in the rebuild's favor — a second optimization *tier* on top of
+the policy set, the standard makespan play when scheduling time is cheap
+relative to execution time (here: milliseconds of host search for
+milliseconds of TPU makespan, re-spent every run of a static graph).
+
+Memory feasibility mirrors pack exactly: a candidate device must hold the
+union of its groups' params plus the largest single-task activation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..backends.sim import LinkModel
+from .base import SchedulerRun
+from .eventsim import simulate_placement
+from .pack import GroupPackScheduler
+from .pipeline import _group_stats
+
+_EPS = 1e-12
+
+
+class RefinedPackScheduler(GroupPackScheduler):
+    """Hill-climbed group placement (pack seed, event-sim objective)."""
+
+    name = "refine"
+
+    def __init__(
+        self,
+        link: Optional[LinkModel] = None,
+        max_evals: int = 400,
+        tol: float = 1e-9,
+    ):
+        super().__init__(link=link)
+        self.max_evals = max_evals
+        self.tol = tol
+
+    def run_policy(self, run: SchedulerRun) -> None:
+        graph, devices = run.graph, run.cluster.devices
+        placed = self.plan(graph, devices)
+        if placed and len(devices) > 1:
+            placed = self._search(run, placed)
+        self.commit(run, placed)
+
+    # -- search ------------------------------------------------------------
+    def _search(
+        self, run: SchedulerRun, placed: Dict[str, int]
+    ) -> Dict[str, int]:
+        graph, devices = run.graph, run.cluster.devices
+        n_dev = len(devices)
+        groups, compute, activ, gparams = _group_stats(graph)
+        gidx = {g: i for i, g in enumerate(groups)}
+        speeds = {d.node_id: d.compute_speed for d in devices}
+        slices = run.cluster.slice_ids()
+        group_of = {
+            t.task_id: (t.group or t.task_id) for t in graph.tasks()
+        }
+
+        def union_gb(names: Set[str]) -> float:
+            return sum(graph.param_size_gb(p) for p in sorted(names))
+
+        def fits(assign: Dict[str, int], d: int) -> bool:
+            members = [g for g, dd in assign.items() if dd == d]
+            params: Set[str] = set()
+            act = 0.0
+            for g in members:
+                params |= gparams[gidx[g]]
+                act = max(act, activ[gidx[g]])
+            return union_gb(params) + act <= devices[d].total_memory + 1e-9
+
+        def evaluate(
+            assign: Dict[str, int]
+        ) -> Tuple[float, Dict[str, float]]:
+            placement = {
+                tid: devices[assign[g]].node_id
+                for tid, g in group_of.items()
+                if g in assign
+            }
+            _, makespan, node_finish = simulate_placement(
+                graph, placement, speeds, self.link, slices
+            )
+            return makespan, node_finish
+
+        best = dict(placed)
+        best_m, node_finish = evaluate(best)
+        evals = 1
+        improved = True
+        while improved and evals < self.max_evals:
+            improved = False
+            # groups on the bottleneck device, heaviest param union first —
+            # moving them is what can shorten the critical device
+            bottleneck = max(node_finish, key=node_finish.get)
+            b_idx = next(
+                i for i, d in enumerate(devices) if d.node_id == bottleneck
+            )
+            hot = sorted(
+                (g for g, d in best.items() if d == b_idx),
+                key=lambda g: -union_gb(gparams[gidx[g]]),
+            )
+            # lighter devices first as destinations
+            dests = sorted(
+                range(n_dev),
+                key=lambda d: node_finish.get(devices[d].node_id, 0.0),
+            )
+            for g in hot:
+                if evals >= self.max_evals or improved:
+                    break
+                for d in dests:
+                    if d == b_idx:
+                        continue
+                    # move g -> d
+                    cand = dict(best)
+                    cand[g] = d
+                    if fits(cand, d):
+                        m, nf = evaluate(cand)
+                        evals += 1
+                        if m < best_m - self.tol:
+                            best, best_m, node_finish = cand, m, nf
+                            improved = True
+                            break
+                        if evals >= self.max_evals:
+                            break
+                    # swap g <-> lightest group on d
+                    there = [g2 for g2, dd in best.items() if dd == d]
+                    if not there:
+                        continue
+                    g2 = min(there, key=lambda x: union_gb(gparams[gidx[x]]))
+                    cand = dict(best)
+                    cand[g], cand[g2] = d, b_idx
+                    if fits(cand, d) and fits(cand, b_idx):
+                        m, nf = evaluate(cand)
+                        evals += 1
+                        if m < best_m - self.tol:
+                            best, best_m, node_finish = cand, m, nf
+                            improved = True
+                            break
+                        if evals >= self.max_evals:
+                            break
+        return best

@@ -117,6 +117,24 @@ def test_build_segments_exports():
     assert segs[1][2] == ("d",)
 
 
+def test_segment_cache_releases_dead_graphs():
+    """The compiled-segment cache is weak-keyed by graph; the jitted value
+    must not capture the graph, or the entry (and its XLA executables)
+    would live for the backend's lifetime."""
+    import gc
+
+    one = Cluster.from_jax_devices(jax.devices()[:1], hbm_cap_gb=8.0)
+    backend = DeviceBackend(one)
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=1, seq_len=16)
+    params, ids = dag.init_params(), dag.make_inputs()
+    schedule = get_scheduler("greedy").schedule(dag.graph, one)
+    backend.execute(dag.graph, schedule, params, ids, segments=True)
+    assert len(backend._seg_cache) == 1
+    del dag, schedule
+    gc.collect()
+    assert len(backend._seg_cache) == 0
+
+
 def test_segmented_skips_failed_upstreams():
     """Fail-and-continue: a task absent from the placement drops its
     dependents from segment execution instead of crashing."""
