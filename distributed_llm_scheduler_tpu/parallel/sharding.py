@@ -43,24 +43,51 @@ GPT2_PARAM_RULES = [
 ]
 
 
-def param_spec(name: str) -> P:
+# Llama-backbone families (llama + mixtral): Megatron split of the GQA
+# attention and the SwiGLU / expert FFNs.  KV projections are column-sharded
+# over tp, so tp must divide n_kv_heads for an even head split (LlamaConfig
+# defaults: 8 kv heads).  The expert suffixes (``e{j}_w_gate`` etc.) match
+# the same FFN rules — dense-dispatch experts tensor-parallelize exactly
+# like the dense FFN.  ``lm_head`` (d, vocab) column-shards when tp divides
+# the vocab (128256 = 8 x 16032); ``tok_emb`` stays replicated (row-sharded
+# gathers cost an all-gather per lookup for ~1 GB saved — the wrong trade
+# at decode time).
+LLAMA_PARAM_RULES = [
+    (r"tok_emb$", P()),
+    (r"(wq|wk|wv)$", P(None, "tp")),     # column: heads split over tp
+    (r"wo$", P("tp", None)),             # row: output partial-summed
+    (r"(w_gate|w_up)$", P(None, "tp")),
+    (r"w_down$", P("tp", None)),
+    (r"router$", P()),
+    (r"lm_head$", P(None, "tp")),
+    (r".*_g$", P()),                     # RMSNorm gains replicated
+    (r".*", P()),
+]
+
+
+def param_spec(name: str, family: str = "gpt2") -> P:
     # stacked-layer params (models/gpt2.stack_layer_params): the leading
     # layer dim is never sharded; the per-layer spec shifts right by one
     if name.startswith("layers_"):
-        return P(None, *param_spec(name[len("layers_"):]))
-    for pattern, spec in GPT2_PARAM_RULES:
+        return P(None, *param_spec(name[len("layers_"):], family))
+    rules = GPT2_PARAM_RULES if family.startswith("gpt2") else LLAMA_PARAM_RULES
+    for pattern, spec in rules:
         if re.search(pattern, name):
             return spec
     return P()
 
 
-def param_shardings(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, NamedSharding]:
-    return {k: NamedSharding(mesh, param_spec(k)) for k in params}
+def param_shardings(
+    mesh: Mesh, params: Dict[str, Any], family: str = "gpt2"
+) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, param_spec(k, family)) for k in params}
 
 
-def shard_params(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+def shard_params(
+    mesh: Mesh, params: Dict[str, Any], family: str = "gpt2"
+) -> Dict[str, Any]:
     """device_put the whole param dict according to the rules."""
-    shardings = param_shardings(mesh, params)
+    shardings = param_shardings(mesh, params, family)
     return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
 
 

@@ -38,8 +38,6 @@ from .eventsim import simulate_placement
 from .pack import GroupPackScheduler
 from .pipeline import _group_stats
 
-_EPS = 1e-12
-
 
 class RefinedPackScheduler(GroupPackScheduler):
     """Hill-climbed group placement (pack seed, event-sim objective)."""
@@ -110,7 +108,11 @@ class RefinedPackScheduler(GroupPackScheduler):
             improved = False
             # groups on the bottleneck device, heaviest param union first —
             # moving them is what can shorten the critical device
-            bottleneck = max(node_finish, key=node_finish.get)
+            # tie-break by node_id: node_finish iterates in set order, so a
+            # bare max() would be PYTHONHASHSEED-dependent on exact ties
+            bottleneck = max(
+                node_finish.items(), key=lambda kv: (kv[1], kv[0])
+            )[0]
             b_idx = next(
                 i for i, d in enumerate(devices) if d.node_id == bottleneck
             )
