@@ -183,6 +183,7 @@ class DeviceBackend:
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
         segments: bool = False,
+        ext_outputs: Optional[Dict[str, Any]] = None,
     ) -> float:
         """Compile every (fn, placement-device) combination ahead of time;
         returns seconds.
@@ -193,9 +194,14 @@ class DeviceBackend:
         """
         t0 = time.perf_counter()
         if segments:
-            self._run_segmented(graph, schedule, placed_params, graph_input)
+            self._run_segmented(
+                graph, schedule, placed_params, graph_input, ext_outputs
+            )
         else:
-            self._run(graph, schedule, placed_params, graph_input, profile=False)
+            self._run(
+                graph, schedule, placed_params, graph_input, profile=False,
+                ext_outputs=ext_outputs,
+            )
         return time.perf_counter() - t0
 
     # -- dispatch order ----------------------------------------------------
@@ -365,6 +371,7 @@ class DeviceBackend:
         schedule: Schedule,
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
+        ext_outputs: Optional[Dict[str, Any]] = None,
     ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
         """Segment-fused execution: same placement, one launch per segment.
         Tasks with failed upstreams are dropped at segment-build time (host
@@ -376,16 +383,17 @@ class DeviceBackend:
         placement = schedule.placement
         order = self.dispatch_order(graph, schedule)
         # drop tasks whose (transitive) producers are unplaced/skipped —
-        # the host-side equivalent of the per-task path's upstream check
-        alive: set = set()
+        # the host-side equivalent of the per-task path's upstream check.
+        # ext_outputs (elastic recovery) count as alive producers.
+        alive: set = set(ext_outputs or ())
         for tid in order:
             aids = graph[tid].arg_tasks or graph[tid].dependencies
             if all(d in alive for d in aids):
                 alive.add(tid)
-        order = [t for t in order if t in alive]
+        order = [t for t in order if t in alive and t not in (ext_outputs or ())]
         segments = self.build_segments(graph, schedule, order)
 
-        outputs: Dict[str, Any] = {}
+        outputs: Dict[str, Any] = dict(ext_outputs or {})
         transfer_edges = 0
         transfer_bytes = 0
         for node, tids, exports in segments:
@@ -448,9 +456,15 @@ class DeviceBackend:
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
         profile: bool,
+        ext_outputs: Optional[Dict[str, Any]] = None,
     ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
         placement = schedule.placement
-        outputs: Dict[str, Any] = {}
+        # ext_outputs seed the value table: surviving outputs of an earlier
+        # (partial) run whose producers are not in this graph — the elastic
+        # recovery path (sched/elastic.py).  They count as transfers when
+        # consumed (they arrive from outside the consuming core).
+        outputs: Dict[str, Any] = dict(ext_outputs or {})
+        n_ext = len(outputs)
         timings: Dict[str, TaskTiming] = {}
         transfer_edges = 0
         transfer_bytes = 0
@@ -468,8 +482,8 @@ class DeviceBackend:
                 for loc, glob in task.param_items()
             }
 
-            if task.dependencies:
-                arg_ids = task.arg_tasks or task.dependencies
+            arg_ids = task.arg_tasks or task.dependencies
+            if arg_ids:
                 if any(d not in outputs for d in arg_ids):
                     continue  # upstream failed; propagate skip
                 args = []
@@ -505,7 +519,7 @@ class DeviceBackend:
         # and per-device queues are FIFO so one fenced value per device
         # proves that device's whole queue drained.
         n_fences = 0
-        if outputs:
+        if len(outputs) > n_ext:
             from ..utils.costmodel import readback_fence
 
             jax.block_until_ready(list(outputs.values()))
@@ -531,7 +545,10 @@ class DeviceBackend:
             readback_fence(combined)
             n_fences = 1
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
-        return final, timings, transfer_edges, transfer_bytes, n_fences, len(outputs)
+        return (
+            final, timings, transfer_edges, transfer_bytes, n_fences,
+            len(outputs) - n_ext,
+        )
 
     def execute(
         self,
@@ -542,8 +559,16 @@ class DeviceBackend:
         profile: bool = False,
         warmup: bool = True,
         segments: bool = False,
+        ext_outputs: Optional[Dict[str, Any]] = None,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
+
+        ``ext_outputs`` seeds task outputs produced OUTSIDE this graph —
+        the elastic-recovery path (``sched/elastic.py``): a remainder
+        graph's tasks may consume, via ``arg_tasks``, outputs of completed
+        tasks that survived a node failure.  Keys are the external task
+        ids; values are host or device arrays (transferred to the
+        consuming core on use).
 
         ``profile=True`` records per-task wall times via per-task
         ``block_until_ready`` (Gantt charts / diagnostics).  CAVEAT: on the
@@ -580,7 +605,8 @@ class DeviceBackend:
         compile_s = 0.0
         if warmup:
             compile_s = self.warmup(
-                graph, schedule, placed, graph_input, segments=segments
+                graph, schedule, placed, graph_input, segments=segments,
+                ext_outputs=ext_outputs,
             )
 
         # fence round-trip, re-measured per execute (outside the timed
@@ -594,11 +620,13 @@ class DeviceBackend:
         t0 = time.perf_counter()
         if segments:
             output, timings, tedges, tbytes, n_fences, n_disp = (
-                self._run_segmented(graph, schedule, placed, graph_input)
+                self._run_segmented(
+                    graph, schedule, placed, graph_input, ext_outputs
+                )
             )
         else:
             output, timings, tedges, tbytes, n_fences, n_disp = self._run(
-                graph, schedule, placed, graph_input, profile
+                graph, schedule, placed, graph_input, profile, ext_outputs
             )
         wall = time.perf_counter() - t0
         makespan = max(wall - n_fences * rtt, 1e-9)

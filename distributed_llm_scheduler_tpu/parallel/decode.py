@@ -63,19 +63,22 @@ def shard_decode_params(
     """
     family = _family_of(config)
     tp = mesh.shape.get("tp", 1)
-    if family != "gpt2" and tp > 1:
-        kv_heads = getattr(config, "n_kv_heads", None) or getattr(
-            config, "n_heads", 1
-        )
+    if tp > 1:
+        if family == "gpt2":
+            # qkv/mlp biases column-shard as P("tp"): widths are 3*d and
+            # 4*d, both tp-divisible iff the head count is (d = heads*hd)
+            kv_heads = config.n_head
+        else:
+            kv_heads = config.n_kv_heads
+            if config.vocab_size % tp != 0:
+                raise ValueError(
+                    f"tp={tp} must divide vocab_size={config.vocab_size} "
+                    "for the column split of lm_head (pick a smaller tp)"
+                )
         if kv_heads % tp != 0:
             raise ValueError(
-                f"tp={tp} must divide n_kv_heads={kv_heads} for the column "
-                "split of wk/wv (pick a smaller tp)"
-            )
-        if config.vocab_size % tp != 0:
-            raise ValueError(
-                f"tp={tp} must divide vocab_size={config.vocab_size} for "
-                "the column split of lm_head (pick a smaller tp)"
+                f"tp={tp} must divide the (kv-)head count {kv_heads} for "
+                "the attention column split (pick a smaller tp)"
             )
     return shard_params(mesh, params, family)
 

@@ -68,7 +68,15 @@ def test_mixtral_expert_ffns_shard_like_dense():
 def test_tp_must_divide_kv_heads():
     _, config, params, _ = _setup("llama")  # tiny has 2 kv heads
     mesh = make_mesh(dp=1, tp=4)
-    with pytest.raises(ValueError, match="n_kv_heads"):
+    with pytest.raises(ValueError, match="head count"):
+        shard_decode_params(mesh, params, config)
+
+
+def test_gpt2_tp_must_divide_heads():
+    mod, config = FAMILIES["gpt2"]
+    params = mod.init_params(config, jax.random.PRNGKey(0))
+    mesh = make_mesh(dp=1, tp=8)  # tiny gpt2 has 4 heads
+    with pytest.raises(ValueError, match="head count"):
         shard_decode_params(mesh, params, config)
 
 
