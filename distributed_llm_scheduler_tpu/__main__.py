@@ -56,16 +56,39 @@ def _config_from(args: argparse.Namespace):
     return RunConfig(**kw)
 
 
-def _load_gpt2_weights(path: str, config):
+# families with HF name maps (frontend/pretrained.py); drives both the
+# fail-fast family check and the mapper dispatch
+_WEIGHT_MAPPERS = {
+    "gpt2": "gpt2_params_from_state_dict",
+    "llama": "llama_params_from_state_dict",
+}
+_WEIGHTS_UNSUPPORTED = (
+    f"--weights supports the {' and '.join(sorted(_WEIGHT_MAPPERS))} "
+    "families (HF name maps in frontend/pretrained.py)"
+)
+
+
+def _weights_family(model_name: str):
+    return next(
+        (f for f in _WEIGHT_MAPPERS if model_name.startswith(f)), None
+    )
+
+
+def _load_pretrained_weights(path: str, config, model_name: str):
     """torch state-dict file -> flat param dict, or None after printing the
     error (shared by ``execute --weights`` and ``generate --weights``)."""
     import torch
 
-    from .frontend.pretrained import gpt2_params_from_state_dict
+    from .frontend import pretrained
 
+    family = _weights_family(model_name)
+    if family is None:
+        print(_WEIGHTS_UNSUPPORTED, file=sys.stderr)
+        return None
+    mapper = getattr(pretrained, _WEIGHT_MAPPERS[family])
     try:
         sd = torch.load(path, map_location="cpu", weights_only=True)
-        params = gpt2_params_from_state_dict(sd, config)
+        params = mapper(sd, config)
     except (OSError, ValueError, RuntimeError) as e:
         print(f"--weights {path}: {e}", file=sys.stderr)
         return None
@@ -154,10 +177,9 @@ def cmd_execute(args) -> int:
               "detected, not configured; drop --slices (use `schedule "
               "--slices N` for modeled multislice runs)", file=sys.stderr)
         return 2
-    if cfg.weights and not cfg.model.startswith("gpt2"):
+    if cfg.weights and _weights_family(cfg.model) is None:
         # fail fast, before graph build / device binding / scheduling
-        print("--weights supports the gpt2 family (the HF name map "
-              "in frontend/pretrained.py)", file=sys.stderr)
+        print(_WEIGHTS_UNSUPPORTED, file=sys.stderr)
         return 2
     dag = cfg.build_graph()
     if not hasattr(dag, "graph"):
@@ -170,7 +192,7 @@ def cmd_execute(args) -> int:
     if cfg.weights:
         from .frontend.pretrained import fit_params_to_dag
 
-        params = _load_gpt2_weights(cfg.weights, dag.config)
+        params = _load_pretrained_weights(cfg.weights, dag.config, cfg.model)
         if params is None:
             return 2
         try:
@@ -278,11 +300,7 @@ def cmd_generate(args) -> int:
     }[args.model[0]]
 
     if args.weights:
-        if not args.model.startswith("gpt2"):
-            print("--weights supports the gpt2 family (the HF name map in "
-                  "frontend/pretrained.py)", file=sys.stderr)
-            return 2
-        params = _load_gpt2_weights(args.weights, config)
+        params = _load_pretrained_weights(args.weights, config, args.model)
         if params is None:
             return 2
     else:

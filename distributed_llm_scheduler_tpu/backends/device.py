@@ -424,14 +424,16 @@ class DeviceBackend:
             outputs.update(fn(union, ext))
 
         n_fences = 0
-        if outputs:
+        last_on_device: Dict[str, Any] = {}
+        for node, tids, exports in segments:
+            if exports:
+                last_on_device[node] = outputs[exports[-1]]
+        # guard on executed segments, not `outputs` — ext_outputs seeds can
+        # make `outputs` non-empty when nothing actually ran
+        if last_on_device:
             from ..utils.costmodel import readback_fence
 
             jax.block_until_ready(list(outputs.values()))
-            last_on_device: Dict[str, Any] = {}
-            for node, tids, exports in segments:
-                if exports:
-                    last_on_device[node] = outputs[exports[-1]]
             fence_dev = self._fence_device()
             tips = []
             for out in last_on_device.values():

@@ -154,6 +154,133 @@ def load_gpt2_pretrained(
     )
 
 
+# -- Llama family ------------------------------------------------------------
+
+# HF stores torch.nn.Linear weights (out_features, in_features); our matmuls
+# are x @ w with w (in, out), so every projection transposes on ingestion.
+_LLAMA_TOP = {
+    "embed_tokens.weight": ("tok_emb", False),
+    "norm.weight": ("final_norm_g", False),
+}
+_LLAMA_PER_LAYER = {
+    "input_layernorm.weight": ("attn_norm_g", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "post_attention_layernorm.weight": ("ffn_norm_g", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+
+def _interleave_rope_columns(w: "np.ndarray", n_heads: int) -> "np.ndarray":
+    """Permute q/k projection output columns from HF's rotate-half RoPE
+    layout to our interleaved-pair layout.
+
+    HF rotates pairs ``(j, j + hd/2)`` within each head; our
+    :func:`..models.llama.apply_rope` rotates pairs ``(2j, 2j+1)`` with the
+    SAME per-pair frequencies.  Mapping new column ``2j -> old j`` and
+    ``2j+1 -> old j + hd/2`` per head makes our rope reproduce HF's math
+    exactly; attention is invariant to the (shared) q/k permutation.  The
+    same permutation llama.cpp's checkpoint converter applies.
+    """
+    d_in, out = w.shape
+    hd = out // n_heads
+    w = w.reshape(d_in, n_heads, 2, hd // 2)
+    w = w.transpose(0, 1, 3, 2)
+    return w.reshape(d_in, out)
+
+
+def llama_params_from_state_dict(
+    state_dict: Mapping[str, Any],
+    config: Any,
+    dtype: Optional[Any] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Name-map a HF Llama state dict into our flat param dict.
+
+    Accepts ``LlamaModel`` or ``LlamaForCausalLM`` state dicts.  Beyond
+    renaming: Linear weights transpose to (in, out), and q/k projections
+    additionally permute per head for the RoPE-convention difference
+    (:func:`_interleave_rope_columns`) — logits parity against the donor
+    torch model is pinned in ``tests/test_pretrained.py``.  A missing
+    ``lm_head.weight`` (tied embeddings) falls back to ``tok_emb.T``.
+    """
+    from ..models.llama import param_shapes as llama_param_shapes
+
+    dtype = dtype if dtype is not None else config.dtype
+    expected = {k: shape for k, (shape, _) in llama_param_shapes(config).items()}
+    hd = config.head_dim
+
+    out: Dict[str, jnp.ndarray] = {}
+    unknown = []
+    for name, value in state_dict.items():
+        if name.startswith("model."):
+            name = name[len("model."):]
+        if name.endswith("rotary_emb.inv_freq"):
+            continue  # derived buffer, not a parameter
+        transpose = False
+        ours = None
+        if name == "lm_head.weight":
+            ours, transpose = "lm_head", True
+        elif name in _LLAMA_TOP:
+            ours, transpose = _LLAMA_TOP[name]
+        elif name.startswith("layers."):
+            _, idx, rest = name.split(".", 2)
+            per = _LLAMA_PER_LAYER.get(rest)
+            if per is not None and idx.isdigit():
+                ours, transpose = f"l{idx}_{per[0]}", per[1]
+        if ours is None:
+            unknown.append(name)
+            continue
+        arr = _to_numpy(value)
+        if transpose:
+            arr = arr.T
+        if ours.endswith("_wq") or ours.endswith("_wk"):
+            heads = arr.shape[1] // hd
+            arr = _interleave_rope_columns(arr, heads)
+        want = expected.get(ours)
+        if want is None:
+            raise ValueError(
+                f"{name!r} maps to {ours!r} which is not a parameter of "
+                f"this config (n_layers={config.n_layers}?)"
+            )
+        if tuple(arr.shape) != tuple(want):
+            raise ValueError(
+                f"shape mismatch for {name!r} -> {ours!r}: "
+                f"checkpoint {tuple(arr.shape)} vs config {tuple(want)}"
+            )
+        out[ours] = jnp.asarray(arr, dtype=dtype)
+
+    if unknown:
+        raise ValueError(f"unrecognized state-dict entries: {sorted(unknown)}")
+    if "lm_head" not in out and "tok_emb" in out:
+        out["lm_head"] = out["tok_emb"].T  # tied embeddings
+    missing = sorted(set(expected) - set(out))
+    if missing:
+        raise ValueError(f"state dict is missing parameters: {missing}")
+    return out
+
+
+def llama_config_from_hf(hf_config: Any, dtype: Any = jnp.float32):
+    """Our LlamaConfig from a ``transformers.LlamaConfig`` (structure only)."""
+    from ..models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        ffn_hidden=hf_config.intermediate_size,
+        rope_theta=float(hf_config.rope_theta),
+        rms_eps=float(hf_config.rms_norm_eps),
+        dtype=dtype,
+    )
+
+
 def fit_params_to_dag(dag: Any, params: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     """Derive any DAG-build-specific params missing from a base checkpoint.
 
@@ -165,13 +292,29 @@ def fit_params_to_dag(dag: Any, params: Dict[str, jnp.ndarray]) -> Dict[str, jnp
     from .vocab_sharding import shard_bounds
 
     out = dict(params)
-    shard_keys = sorted(
-        k for k in dag.param_specs if k.startswith("wte_shard_")
+    # GPT-2 family: row slices of the tied wte table.  Keys constructed
+    # from the index — lexicographic sorting would misorder shard_10
+    # before shard_2 at 10+ shards
+    n_wte = sum(
+        1 for k in dag.param_specs if k.startswith("wte_shard_")
     )
-    if shard_keys:
-        lo = shard_bounds(dag.config.vocab_size, len(shard_keys))
-        for k, key in enumerate(shard_keys):
-            out.setdefault(key, out["wte"][lo[k]:lo[k + 1]])
+    if n_wte:
+        lo = shard_bounds(dag.config.vocab_size, n_wte)
+        for k in range(n_wte):
+            out.setdefault(f"wte_shard_{k}", out["wte"][lo[k]:lo[k + 1]])
+    # Llama backbone: tok_emb row slices + lm_head column slices
+    emb_keys = sorted(
+        k for k in dag.param_specs if k.startswith("tok_emb_shard_")
+    )
+    if emb_keys:
+        lo = shard_bounds(dag.config.vocab_size, len(emb_keys))
+        for k in range(len(emb_keys)):
+            out.setdefault(
+                f"tok_emb_shard_{k}", out["tok_emb"][lo[k]:lo[k + 1]]
+            )
+            out.setdefault(
+                f"lm_head_shard_{k}", out["lm_head"][:, lo[k]:lo[k + 1]]
+            )
     missing = sorted(set(dag.param_specs) - set(out))
     if missing:
         raise ValueError(f"params missing for DAG {dag.graph.name}: {missing}")

@@ -94,9 +94,11 @@ def remainder_graph(
             params_needed=set(t.params_needed),
             param_bytes=dict(t.param_bytes),
             fn=t.fn,
-            arg_tasks=(
-                list(t.arg_tasks) if t.arg_tasks is not None else None
-            ),
+            # materialize the implicit args-are-deps default BEFORE pruning:
+            # the remainder task's dependencies shrink, but its fn still
+            # consumes the original producers' outputs (surviving ones via
+            # DeviceBackend ext_outputs)
+            arg_tasks=list(t.arg_tasks or t.dependencies),
             param_alias=copy.copy(t.param_alias),
             out_shape=t.out_shape,
             out_bytes=t.out_bytes,

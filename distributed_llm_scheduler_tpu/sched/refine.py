@@ -30,6 +30,7 @@ union of its groups' params plus the largest single-task activation.
 
 from __future__ import annotations
 
+import random
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..backends.sim import LinkModel
@@ -100,63 +101,104 @@ class RefinedPackScheduler(GroupPackScheduler):
             )
             return makespan, node_finish
 
-        best = dict(placed)
-        best_m, node_finish = evaluate(best)
-        evals = 1
-        improved = True
-        while improved and evals < self.max_evals:
-            improved = False
-            # groups on the bottleneck device, heaviest param union first —
-            # moving them is what can shorten the critical device
-            # tie-break by node_id: node_finish iterates in set order, so a
-            # bare max() would be PYTHONHASHSEED-dependent on exact ties
-            bottleneck = max(
-                node_finish.items(), key=lambda kv: (kv[1], kv[0])
-            )[0]
-            b_idx = next(
-                i for i, d in enumerate(devices) if d.node_id == bottleneck
-            )
-            hot = sorted(
-                (g for g, d in best.items() if d == b_idx),
-                key=lambda g: -union_gb(gparams[gidx[g]]),
-            )
-            # lighter devices first as destinations
-            dests = sorted(
-                range(n_dev),
-                key=lambda d: node_finish.get(devices[d].node_id, 0.0),
-            )
-            for g in hot:
-                if evals >= self.max_evals or improved:
-                    break
-                for d in dests:
-                    if d == b_idx:
-                        continue
-                    # move g -> d
-                    cand = dict(best)
-                    cand[g] = d
-                    if fits(cand, d):
-                        m, nf = evaluate(cand)
-                        evals += 1
-                        if m < best_m - self.tol:
-                            best, best_m, node_finish = cand, m, nf
-                            improved = True
-                            break
-                        if evals >= self.max_evals:
-                            break
-                    # swap g <-> lightest group on d
-                    there = [g2 for g2, dd in best.items() if dd == d]
-                    if not there:
-                        continue
-                    g2 = min(there, key=lambda x: union_gb(gparams[gidx[x]]))
-                    cand = dict(best)
-                    cand[g], cand[g2] = d, b_idx
-                    if fits(cand, d) and fits(cand, b_idx):
-                        m, nf = evaluate(cand)
-                        evals += 1
-                        if m < best_m - self.tol:
-                            best, best_m, node_finish = cand, m, nf
-                            improved = True
-                            break
-                        if evals >= self.max_evals:
-                            break
+        evals = 0
+
+        def climb(start: Dict[str, int], start_m, start_nf):
+            """First-improvement hill climbing from one placement."""
+            nonlocal evals
+            cur, cur_m, node_finish = dict(start), start_m, start_nf
+            improved = True
+            while improved and evals < self.max_evals:
+                improved = False
+                # groups on the bottleneck device, heaviest param union
+                # first — moving them is what shortens the critical device.
+                # tie-break by node_id: node_finish iterates in set order,
+                # so bare max() would be PYTHONHASHSEED-dependent on ties
+                bottleneck = max(
+                    node_finish.items(), key=lambda kv: (kv[1], kv[0])
+                )[0]
+                b_idx = next(
+                    i for i, d in enumerate(devices)
+                    if d.node_id == bottleneck
+                )
+                hot = sorted(
+                    (g for g, d in cur.items() if d == b_idx),
+                    key=lambda g: -union_gb(gparams[gidx[g]]),
+                )
+                # lighter devices first as destinations
+                dests = sorted(
+                    range(n_dev),
+                    key=lambda d: node_finish.get(devices[d].node_id, 0.0),
+                )
+                for g in hot:
+                    if evals >= self.max_evals or improved:
+                        break
+                    for d in dests:
+                        if d == b_idx:
+                            continue
+                        # move g -> d
+                        cand = dict(cur)
+                        cand[g] = d
+                        if fits(cand, d):
+                            m, nf = evaluate(cand)
+                            evals += 1
+                            if m < cur_m - self.tol:
+                                cur, cur_m, node_finish = cand, m, nf
+                                improved = True
+                                break
+                            if evals >= self.max_evals:
+                                break
+                        # swap g <-> lightest group on d
+                        there = [g2 for g2, dd in cur.items() if dd == d]
+                        if not there:
+                            continue
+                        g2 = min(
+                            there, key=lambda x: union_gb(gparams[gidx[x]])
+                        )
+                        cand = dict(cur)
+                        cand[g], cand[g2] = d, b_idx
+                        if fits(cand, d) and fits(cand, b_idx):
+                            m, nf = evaluate(cand)
+                            evals += 1
+                            if m < cur_m - self.tol:
+                                cur, cur_m, node_finish = cand, m, nf
+                                improved = True
+                                break
+                            if evals >= self.max_evals:
+                                break
+            return cur, cur_m, node_finish
+
+        seed_m, seed_nf = evaluate(placed)
+        evals += 1
+        best, best_m, _ = climb(placed, seed_m, seed_nf)
+
+        # basin hopping: hill climbing converges in tens of evals; spend
+        # the remaining budget escaping its local optimum — perturb the
+        # incumbent by a few random feasible group moves (seeded RNG:
+        # deterministic across runs and processes) and re-climb, keeping
+        # the global best
+        rng = random.Random(0)
+        glist = sorted(best)
+        stale = 0  # consecutive failures to produce any feasible change
+        while evals + 2 < self.max_evals and glist and stale < 10:
+            cand = dict(best)
+            for _ in range(3):
+                g = rng.choice(glist)
+                d = rng.randrange(n_dev)
+                if d != cand[g]:
+                    moved = dict(cand)
+                    moved[g] = d
+                    if fits(moved, d):
+                        cand = moved
+            if cand == best:
+                # every proposed move was infeasible; don't burn the whole
+                # budget re-evaluating the unchanged incumbent
+                stale += 1
+                continue
+            stale = 0
+            m, nf = evaluate(cand)
+            evals += 1
+            cur, cur_m, _ = climb(cand, m, nf)
+            if cur_m < best_m - self.tol:
+                best, best_m = cur, cur_m
         return best

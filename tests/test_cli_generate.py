@@ -40,10 +40,29 @@ def test_generate_rejects_bad_prompt():
     assert r.returncode == 2  # out of tiny vocab range
 
 
-def test_generate_rejects_weights_for_llama():
-    r = _run("--model", "llama-tiny", "--weights", "/nonexistent.pt")
+def test_generate_rejects_weights_for_mixtral():
+    r = _run("--model", "mixtral-tiny", "--weights", "/nonexistent.pt")
     assert r.returncode == 2
-    assert "gpt2 family" in r.stderr
+    assert "gpt2 and llama families" in r.stderr
+
+
+def test_generate_with_llama_weights(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    hf = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, rms_norm_eps=1e-5, max_position_embeddings=128,
+        attention_bias=False, tie_word_embeddings=False,
+    )
+    donor = transformers.LlamaForCausalLM(hf)
+    path = str(tmp_path / "llama_donor.pt")
+    torch.save(donor.state_dict(), path)
+    r = _run("--model", "llama-tiny", "--weights", path,
+             "--prompt-ids", "1,2,3", "--max-new-tokens", "3")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert len(out["generated_ids"]) == 3
 
 
 def test_generate_with_pretrained_weights(tmp_path):
