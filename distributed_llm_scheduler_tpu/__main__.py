@@ -61,6 +61,7 @@ def _config_from(args: argparse.Namespace):
 _WEIGHT_MAPPERS = {
     "gpt2": "gpt2_params_from_state_dict",
     "llama": "llama_params_from_state_dict",
+    "mixtral": "mixtral_params_from_state_dict",
 }
 _WEIGHTS_UNSUPPORTED = (
     f"--weights supports the {' and '.join(sorted(_WEIGHT_MAPPERS))} "

@@ -193,26 +193,18 @@ def _interleave_rope_columns(w: "np.ndarray", n_heads: int) -> "np.ndarray":
     return w.reshape(d_in, out)
 
 
-def llama_params_from_state_dict(
+def _llama_backbone_params(
     state_dict: Mapping[str, Any],
     config: Any,
-    dtype: Optional[Any] = None,
+    expected: Dict[str, Tuple[int, ...]],
+    per_layer: Mapping[str, Tuple[str, bool]],
+    dtype: Any,
 ) -> Dict[str, jnp.ndarray]:
-    """Name-map a HF Llama state dict into our flat param dict.
-
-    Accepts ``LlamaModel`` or ``LlamaForCausalLM`` state dicts.  Beyond
-    renaming: Linear weights transpose to (in, out), and q/k projections
-    additionally permute per head for the RoPE-convention difference
-    (:func:`_interleave_rope_columns`) — logits parity against the donor
-    torch model is pinned in ``tests/test_pretrained.py``.  A missing
-    ``lm_head.weight`` (tied embeddings) falls back to ``tok_emb.T``.
-    """
-    from ..models.llama import param_shapes as llama_param_shapes
-
-    dtype = dtype if dtype is not None else config.dtype
-    expected = {k: shape for k, (shape, _) in llama_param_shapes(config).items()}
+    """The shared ingestion loop for Llama-backbone families: strip the
+    ``model.`` prefix, rename/transpose per the maps, apply the RoPE
+    column permutation to q/k, shape-check everything, fall back to tied
+    embeddings for a missing ``lm_head.weight``."""
     hd = config.head_dim
-
     out: Dict[str, jnp.ndarray] = {}
     unknown = []
     for name, value in state_dict.items():
@@ -228,7 +220,7 @@ def llama_params_from_state_dict(
             ours, transpose = _LLAMA_TOP[name]
         elif name.startswith("layers."):
             _, idx, rest = name.split(".", 2)
-            per = _LLAMA_PER_LAYER.get(rest)
+            per = per_layer.get(rest)
             if per is not None and idx.isdigit():
                 ours, transpose = f"l{idx}_{per[0]}", per[1]
         if ours is None:
@@ -261,6 +253,84 @@ def llama_params_from_state_dict(
     if missing:
         raise ValueError(f"state dict is missing parameters: {missing}")
     return out
+
+
+def llama_params_from_state_dict(
+    state_dict: Mapping[str, Any],
+    config: Any,
+    dtype: Optional[Any] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Name-map a HF Llama state dict into our flat param dict.
+
+    Accepts ``LlamaModel`` or ``LlamaForCausalLM`` state dicts.  Beyond
+    renaming: Linear weights transpose to (in, out), and q/k projections
+    additionally permute per head for the RoPE-convention difference
+    (:func:`_interleave_rope_columns`) — logits parity against the donor
+    torch model is pinned in ``tests/test_pretrained.py``.  A missing
+    ``lm_head.weight`` (tied embeddings) falls back to ``tok_emb.T``.
+    """
+    from ..models.llama import param_shapes as llama_param_shapes
+
+    dtype = dtype if dtype is not None else config.dtype
+    expected = {k: shape for k, (shape, _) in llama_param_shapes(config).items()}
+    return _llama_backbone_params(
+        state_dict, config, expected, _LLAMA_PER_LAYER, dtype
+    )
+
+
+def mixtral_params_from_state_dict(
+    state_dict: Mapping[str, Any],
+    config: Any,
+    dtype: Optional[Any] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Name-map a HF Mixtral state dict into our flat param dict.
+
+    The attention block is the Llama backbone's (same transposes, same
+    RoPE permutation); the MoE block maps ``block_sparse_moe.gate`` to the
+    router and each expert's ``w1/w3/w2`` to our ``w_gate/w_up/w_down``.
+    HF's routing (softmax over all experts, top-k, renormalize) equals our
+    renormalized-top-k softmax, so logits parity holds end-to-end
+    (``tests/test_pretrained.py``).
+    """
+    from ..models.mixtral import param_shapes as mixtral_param_shapes
+
+    dtype = dtype if dtype is not None else config.dtype
+    expected = {
+        k: shape for k, (shape, _) in mixtral_param_shapes(config).items()
+    }
+    per_layer = {
+        k: v for k, v in _LLAMA_PER_LAYER.items()
+        if not k.startswith("mlp.")
+    }
+    per_layer["block_sparse_moe.gate.weight"] = ("router", True)
+    for e in range(config.n_experts):
+        pre = f"block_sparse_moe.experts.{e}."
+        per_layer[pre + "w1.weight"] = (f"e{e}_w_gate", True)
+        per_layer[pre + "w2.weight"] = (f"e{e}_w_down", True)
+        per_layer[pre + "w3.weight"] = (f"e{e}_w_up", True)
+    return _llama_backbone_params(
+        state_dict, config, expected, per_layer, dtype
+    )
+
+
+def mixtral_config_from_hf(hf_config: Any, dtype: Any = jnp.float32):
+    """Our MixtralConfig from a ``transformers.MixtralConfig``."""
+    from ..models.mixtral import MixtralConfig
+
+    return MixtralConfig(
+        vocab_size=hf_config.vocab_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        ffn_hidden=hf_config.intermediate_size,
+        n_experts=hf_config.num_local_experts,
+        top_k=hf_config.num_experts_per_tok,
+        rope_theta=float(hf_config.rope_theta),
+        rms_eps=float(hf_config.rms_norm_eps),
+        dtype=dtype,
+    )
 
 
 def llama_config_from_hf(hf_config: Any, dtype: Any = jnp.float32):
@@ -302,13 +372,14 @@ def fit_params_to_dag(dag: Any, params: Dict[str, jnp.ndarray]) -> Dict[str, jnp
         lo = shard_bounds(dag.config.vocab_size, n_wte)
         for k in range(n_wte):
             out.setdefault(f"wte_shard_{k}", out["wte"][lo[k]:lo[k + 1]])
-    # Llama backbone: tok_emb row slices + lm_head column slices
-    emb_keys = sorted(
-        k for k in dag.param_specs if k.startswith("tok_emb_shard_")
+    # Llama backbone: tok_emb row slices + lm_head column slices (index
+    # keys, like above — never iterate shard names lexicographically)
+    n_emb = sum(
+        1 for k in dag.param_specs if k.startswith("tok_emb_shard_")
     )
-    if emb_keys:
-        lo = shard_bounds(dag.config.vocab_size, len(emb_keys))
-        for k in range(len(emb_keys)):
+    if n_emb:
+        lo = shard_bounds(dag.config.vocab_size, n_emb)
+        for k in range(n_emb):
             out.setdefault(
                 f"tok_emb_shard_{k}", out["tok_emb"][lo[k]:lo[k + 1]]
             )

@@ -40,10 +40,26 @@ def test_generate_rejects_bad_prompt():
     assert r.returncode == 2  # out of tiny vocab range
 
 
-def test_generate_rejects_weights_for_mixtral():
+def test_generate_weights_missing_file():
     r = _run("--model", "mixtral-tiny", "--weights", "/nonexistent.pt")
+    assert r.returncode == 2  # supported family, missing file
+    assert "/nonexistent.pt" in r.stderr
+
+
+def test_execute_rejects_weights_for_synthetic_model():
+    """The execute-side fail-fast gate for families without an HF map."""
+    env = dict(
+        os.environ,
+        DLS_PLATFORM="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
+         "--model", "llm", "--weights", "/nonexistent.pt"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+    )
     assert r.returncode == 2
-    assert "gpt2 and llama families" in r.stderr
+    assert "families" in r.stderr
 
 
 def test_generate_with_llama_weights(tmp_path):
