@@ -64,7 +64,7 @@ _WEIGHT_MAPPERS = {
     "mixtral": "mixtral_params_from_state_dict",
 }
 _WEIGHTS_UNSUPPORTED = (
-    f"--weights supports the {' and '.join(sorted(_WEIGHT_MAPPERS))} "
+    f"--weights supports the {', '.join(sorted(_WEIGHT_MAPPERS))} "
     "families (HF name maps in frontend/pretrained.py)"
 )
 
@@ -134,6 +134,15 @@ def cmd_schedule(args) -> int:
         "cache_hit_rate": rep.cache_hit_rate,
         "load_balance": rep.load_balance_score,
     }, indent=1, default=str))
+    if args.trace:
+        from .utils.profiling import export_chrome_trace
+
+        try:
+            print("trace ->", export_chrome_trace(schedule, args.trace),
+                  file=sys.stderr)
+        except ValueError as e:  # degenerate replay with no timed tasks
+            print(str(e), file=sys.stderr)
+            return 2
     if args.save:
         print("graph ->", save_graph(graph, f"{cfg.out_dir}/{graph.name}.graph.json"))
         print("schedule ->", save_schedule(
@@ -169,6 +178,11 @@ def cmd_execute(args) -> int:
     if args.profile and args.segments:
         print("--segments fuses away task boundaries; per-task --profile "
               "timings need per-task dispatch", file=sys.stderr)
+        return 2
+    if args.trace and not args.profile:
+        # fail BEFORE the device run: timings only exist in profile mode
+        print("--trace needs per-task timings; add --profile",
+              file=sys.stderr)
         return 2
     if cfg.slices > 1:
         # live clusters carry their REAL slice topology (from_jax_devices
@@ -209,6 +223,15 @@ def cmd_execute(args) -> int:
         segments=args.segments,
     )
     print(json.dumps(rep.summary(), indent=1, default=str))
+    if args.trace:
+        from .utils.profiling import export_chrome_trace
+
+        try:
+            print("trace ->", export_chrome_trace(schedule, args.trace),
+                  file=sys.stderr)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
     return 0
 
 
@@ -366,6 +389,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("schedule", help="place a DAG and report metrics")
     _add_common(p)
+    p.add_argument("--trace", default=None,
+                   help="write the replay timeline as a Chrome/Perfetto "
+                        "trace JSON to this path")
     p.add_argument("--save", action="store_true", help="save graph+schedule JSON")
     p.add_argument("--validate", action="store_true",
                    help="run the independent schedule checker (exit 2 on violations)")
@@ -382,9 +408,13 @@ def main(argv=None) -> int:
     p.add_argument("--segments", action="store_true",
                    help="fuse each device's contiguous scheduled run into "
                         "one XLA launch (incompatible with --profile)")
+    p.add_argument("--trace", default=None,
+                   help="write measured task timeline (needs --profile) as "
+                        "a Chrome/Perfetto trace JSON to this path")
     p.add_argument("--weights", default=None,
-                   help="torch state-dict file with pretrained GPT-2 "
-                        "weights (HF layout); random init when omitted")
+                   help="torch state-dict file with pretrained GPT-2 / "
+                        "Llama / Mixtral weights (HF layout); random "
+                        "init when omitted")
     p.set_defaults(fn=cmd_execute)
 
     p = sub.add_parser("visualize", help="render DAG + Gantt PNGs")
@@ -423,8 +453,9 @@ def main(argv=None) -> int:
                    help="0 = greedy")
     p.add_argument("--top-k", type=int, default=0, dest="top_k")
     p.add_argument("--weights", default=None,
-                   help="torch state-dict file with pretrained GPT-2 "
-                        "weights (HF layout); random init when omitted")
+                   help="torch state-dict file with pretrained GPT-2 / "
+                        "Llama / Mixtral weights (HF layout); random "
+                        "init when omitted")
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_generate)
 

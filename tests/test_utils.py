@@ -157,3 +157,52 @@ def test_cli_help():
     assert r.returncode == 0
     for cmd in ("schedule", "sweep", "execute", "visualize", "train", "bench"):
         assert cmd in r.stdout
+
+
+def test_export_chrome_trace(tmp_path):
+    """Replay timings -> Chrome/Perfetto trace JSON: one thread per
+    device, one complete event per task, microsecond timestamps."""
+    import json
+
+    from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+    from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
+    from distributed_llm_scheduler_tpu.frontend.generators import (
+        generate_llm_dag,
+    )
+    from distributed_llm_scheduler_tpu.utils.profiling import (
+        export_chrome_trace,
+    )
+
+    graph = generate_llm_dag(num_layers=3, num_heads=2, seed=1)
+    cluster = Cluster.uniform(2, 16.0)
+    schedule = get_scheduler("critical").schedule(graph, cluster)
+    SimulatedBackend().execute(graph, cluster, schedule)
+    path = export_chrome_trace(schedule, str(tmp_path / "t.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    tasks = [e for e in events if e["ph"] == "X"]
+    threads = [e for e in events if e["name"] == "thread_name"]
+    assert len(tasks) == len(schedule.timings)
+    assert len(threads) == len({t.node_id for t in schedule.timings.values()})
+    for e in tasks:
+        assert e["dur"] >= 0 and e["ts"] >= 0
+
+
+def test_export_chrome_trace_requires_timings(tmp_path):
+    import pytest as _pytest
+
+    from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+    from distributed_llm_scheduler_tpu.frontend.generators import (
+        generate_llm_dag,
+    )
+    from distributed_llm_scheduler_tpu.utils.profiling import (
+        export_chrome_trace,
+    )
+
+    graph = generate_llm_dag(num_layers=2, num_heads=2, seed=1)
+    schedule = get_scheduler("roundrobin").schedule(
+        graph, Cluster.uniform(2, 16.0)
+    )
+    with _pytest.raises(ValueError, match="no timings"):
+        export_chrome_trace(schedule, str(tmp_path / "t.json"))
