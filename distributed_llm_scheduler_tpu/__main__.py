@@ -34,6 +34,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="shard the embedding/LM-head tables across tasks")
     p.add_argument("--fuse", action="store_true",
                    help="fuse linear task chains before scheduling")
+    p.add_argument("--quantize", default="none", choices=["none", "int8"],
+                   help="int8: per-channel weight quantization — halves/"
+                        "quarters param bytes for placement, loads, and HBM")
     p.add_argument("--train-step", action="store_true",
                    help="schedule one fwd+bwd+optimizer step (gpt2* models)")
     p.add_argument("--num-layers", type=int, default=None)
@@ -215,6 +218,11 @@ def cmd_execute(args) -> int:
         except ValueError as e:
             print(f"--weights {cfg.weights}: {e}", file=sys.stderr)
             return 2
+        if cfg.quantize == "int8":
+            # checkpoints load in fp; convert to the quantized DAG's layout
+            from .utils.quantize import quantize_like
+
+            params = quantize_like(dag, params)
     else:
         params = dag.init_params()
     ids = dag.make_inputs()

@@ -153,8 +153,9 @@ class DeviceBackend:
                 if key not in placed:
                     placed[key] = jax.device_put(params[p], dev)
                     bytes_per_node[node_id] += _array_bytes(params[p])
-        for v in placed.values():
-            v.block_until_ready()
+        # placed values may be pytrees (e.g. QParam int8+scale pairs), so
+        # use the pytree-aware barrier
+        jax.block_until_ready(list(placed.values()))
         return placed, bytes_per_node
 
     # -- compilation -------------------------------------------------------

@@ -22,6 +22,7 @@ class RunConfig:
     microbatches: int = 1
     vocab_shards: int = 1          # shard the embedding/LM-head tables
     fuse: bool = False             # fuse linear task chains (core/fusion.py)
+    quantize: str = "none"         # none | int8 (utils/quantize.py)
     num_layers: Optional[int] = None  # synthetic workloads / overrides
     train_step: bool = False       # schedule one fwd+bwd+opt step (gpt2*)
 
@@ -130,6 +131,22 @@ class RunConfig:
             )
         if self.train_step and self.fuse:
             raise ValueError("--train-step does not support --fuse yet")
+        if self.quantize not in ("none", "int8"):
+            raise ValueError(
+                f"unknown quantize mode {self.quantize!r}; choose none | int8"
+            )
+        if self.quantize != "none" and self.train_step:
+            raise ValueError(
+                "--train-step does not support --quantize (int8 weights "
+                "are an inference-path representation)"
+            )
+        if self.quantize != "none" and self._model_family() is None:
+            # silently ignoring the flag would report full-precision
+            # numbers as quantized ones
+            raise ValueError(
+                "--quantize needs a real model family (gpt2*/llama*/"
+                "mixtral*); synthetic graphs carry no weights to quantize"
+            )
 
         family = self._model_family()
         if family is not None:
@@ -153,6 +170,10 @@ class RunConfig:
                 dag = dataclasses.replace(
                     dag, graph=fuse_linear_chains(dag.graph)
                 )
+            if self.quantize == "int8":
+                from .quantize import quantize_dag
+
+                dag = quantize_dag(dag)
             return dag
         makers = {
             "llm": lambda: generators.generate_llm_dag(

@@ -1,0 +1,266 @@
+"""Int8 weight quantization for memory-constrained scheduling.
+
+The reference's founding premise is fitting models into too-little memory
+(its paper schedules a 37.5 GB-param GPT-2 across 28 GB of laptops);
+quantization attacks the same constraint at the representation level:
+symmetric per-channel int8 weights halve (vs bf16) or quarter (vs f32)
+every number the scheduler optimizes — per-param bytes in ``can_fit``,
+host-link load times in the replay, HBM residency on chips.
+
+Design (TPU-first):
+
+* a quantized param is a :class:`QParam` pytree ``(q: int8, scale: f32)``
+  with per-last-axis-channel absmax scales — it flows through
+  ``jax.device_put`` / pytree utilities like any array pair;
+* task fns never change: :func:`quantize_dag` wraps each distinct fn ONCE
+  (preserving the shared-fn jit-cache economy) with a shim that
+  dequantizes ``QParam`` entries back to the param's original dtype before
+  calling through.  Dequantization happens ON DEVICE inside the jitted
+  task — XLA fuses the ``int8 -> float`` convert+scale into the consuming
+  matmul, so HBM traffic and transfers stay int8 and only VMEM sees
+  floats;
+* scheduling sees the truth: ``Task.param_bytes`` shrink to the int8+scale
+  sizes, and the graph name gains an ``_int8`` tag so measured cost-model
+  caches can't cross-contaminate precision regimes.
+
+Only float params with >= ``min_elems`` elements and >= 2 dims quantize —
+norms gains/biases (tiny, precision-critical) stay in their original
+dtype.  The embedding table quantizes per row-channel like any matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.graph import TaskGraph, TaskStatus
+
+
+class QParam(NamedTuple):
+    """Symmetric per-channel int8 weight: ``deq = q * scale``."""
+
+    q: jax.Array      # int8, original shape
+    # float32, shape (1, ..., 1, last): one scale per last-axis channel,
+    # broadcasting over every leading axis
+    scale: jax.Array
+
+
+def should_quantize(spec: Any, min_elems: int = 4096) -> bool:
+    """Quantize float tensors with >= 2 dims and >= min_elems elements."""
+    if isinstance(spec, QParam):
+        return False
+    shape = tuple(spec.shape)
+    if len(shape) < 2:
+        return False
+    size = 1
+    for s in shape:
+        size *= s
+    return size >= min_elems and jnp.issubdtype(
+        jnp.dtype(spec.dtype), jnp.floating
+    )
+
+
+def quantize_array(x: jax.Array) -> QParam:
+    """Symmetric absmax int8 over every axis but the last (per-channel)."""
+    xf = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=tuple(range(xf.ndim - 1)), keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return QParam(q=q, scale=scale)
+
+
+def dequantize(v: Any, dtype: Any) -> Any:
+    """QParam -> dense array in ``dtype``; anything else passes through."""
+    if isinstance(v, QParam):
+        return (v.q.astype(jnp.float32) * v.scale).astype(dtype)
+    return v
+
+
+def qparam_bytes(spec: Any) -> int:
+    """On-the-wire bytes of the quantized form of ``spec``: int8 values
+    plus one float32 scale per last-axis channel (quantize_array's
+    layout)."""
+    shape = tuple(spec.shape)
+    n = 1
+    for s in shape:
+        n *= s
+    return n * 1 + shape[-1] * 4
+
+
+def quantize_params(
+    params: Dict[str, Any], min_elems: int = 4096
+) -> Dict[str, Any]:
+    """Quantize every qualifying entry of a flat param dict."""
+    return {
+        k: quantize_array(v) if should_quantize(v, min_elems) else v
+        for k, v in params.items()
+    }
+
+
+def _shard_groups(names) -> Dict[str, list]:
+    """``{base: [(k, shard_name), ...]}`` for ``{base}_shard_{k}`` keys."""
+    import re
+
+    groups: Dict[str, list] = {}
+    for name in names:
+        m = re.fullmatch(r"(.+)_shard_(\d+)", name)
+        if m:
+            groups.setdefault(m.group(1), []).append((int(m.group(2)), name))
+    for entries in groups.values():
+        entries.sort()
+    return groups
+
+
+def rederive_shard_quants(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Make vocab-shard quantization coherent with the base table's.
+
+    ``{base}_shard_{k}`` entries (vocab sharding: tok_emb/wte row slices,
+    lm_head column slices) must carry slices of the BASE table's quantized
+    values, not an independent quantization — otherwise the shard-consuming
+    DAG path and the full-table fused oracle disagree by re-rounding noise.
+    Row slices reuse the base's per-column scales verbatim; column slices
+    take the matching scale columns.
+    """
+    out = dict(params)
+    for base, entries in _shard_groups(params).items():
+        bq = out.get(base)
+        if not isinstance(bq, QParam):
+            continue
+        off = 0
+        base_shape = bq.q.shape
+        for _, name in entries:
+            if not isinstance(out.get(name), QParam):
+                continue
+            shape = out[name].q.shape
+            if shape[1:] == base_shape[1:]:  # row slice (tok_emb/wte)
+                out[name] = QParam(
+                    q=bq.q[off:off + shape[0]], scale=bq.scale
+                )
+                off += shape[0]
+            elif shape[:-1] == base_shape[:-1]:  # column slice (lm_head)
+                out[name] = QParam(
+                    q=bq.q[..., off:off + shape[-1]],
+                    scale=bq.scale[..., off:off + shape[-1]],
+                )
+                off += shape[-1]
+    return out
+
+
+def quantize_like(dag: Any, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize exactly the params a quantized DAG's specs mark quantized —
+    the ingestion path (``--weights`` + ``--quantize``): external fp
+    checkpoints are fitted first, then converted to the DAG's layout."""
+    out = {}
+    for k, v in params.items():
+        spec = dag.param_specs.get(k)
+        if isinstance(spec, QParam) and not isinstance(v, QParam):
+            out[k] = quantize_array(v)
+        else:
+            out[k] = v
+    return rederive_shard_quants(out)
+
+
+def quantize_dag(dag: Any, min_elems: int = 4096) -> Any:
+    """A ModelDAG whose qualifying weights are int8 end-to-end.
+
+    Returns a new dag (the input is untouched): fns wrapped with on-device
+    dequantization, ``param_bytes`` shrunk to int8+scale sizes, specs
+    swapped to QParam pytrees, ``init_params``/``reference_forward``
+    quantization-aware, and the graph renamed with an ``_int8`` tag (cost
+    model caches key on the name).
+    """
+    quantized = {
+        name for name, spec in dag.param_specs.items()
+        if should_quantize(spec, min_elems)
+    }
+    spec_dtype = {
+        name: jnp.dtype(spec.dtype) for name, spec in dag.param_specs.items()
+    }
+
+    # wrap each distinct fn object once so structurally identical tasks
+    # keep sharing one jitted callable after the transform
+    wrapped: Dict[Any, Callable[..., Any]] = {}
+
+    def wrap(fn, local_dtypes):
+        key = (fn, tuple(sorted(local_dtypes.items())))
+        w = wrapped.get(key)
+        if w is None:
+            def w(pd, *args, _fn=fn, _dt=dict(local_dtypes)):
+                deq = {
+                    loc: dequantize(v, _dt.get(loc, jnp.float32))
+                    for loc, v in pd.items()
+                }
+                return _fn(deq, *args)
+
+            wrapped[key] = w
+        return w
+
+    new_graph = TaskGraph(name=f"{dag.graph.name}_int8")
+    for tid in dag.graph.topo_order:
+        t = dag.graph[tid]
+        pb = dict(t.param_bytes)
+        local_dtypes = {}
+        for loc, glob in t.param_items():
+            if glob in quantized:
+                pb[glob] = qparam_bytes(dag.param_specs[glob])
+                local_dtypes[loc] = spec_dtype[glob]
+        nt = dataclasses.replace(
+            t,
+            # only tasks that actually touch quantized params get the
+            # dequant shim; others keep their fn identity (and jit cache)
+            fn=(
+                wrap(t.fn, local_dtypes)
+                if t.fn is not None and local_dtypes
+                else t.fn
+            ),
+            param_bytes=pb,
+            dependencies=list(t.dependencies),
+            params_needed=set(t.params_needed),
+            arg_tasks=list(t.arg_tasks) if t.arg_tasks is not None else None,
+            status=TaskStatus.PENDING,
+            assigned_node=None,
+        )
+        new_graph.add_task(nt)
+    new_graph.freeze()
+
+    new_specs = {
+        name: (
+            QParam(
+                q=jax.ShapeDtypeStruct(spec.shape, jnp.int8),
+                scale=jax.ShapeDtypeStruct(
+                    (1,) * (len(spec.shape) - 1) + (spec.shape[-1],),
+                    jnp.float32,
+                ),
+            )
+            if name in quantized
+            else spec
+        )
+        for name, spec in dag.param_specs.items()
+    }
+
+    base_init = dag.init_fn
+    base_forward = dag.reference_forward
+
+    def init_fn(key):
+        return rederive_shard_quants({
+            k: quantize_array(v) if k in quantized else v
+            for k, v in base_init(key).items()
+        })
+
+    def reference_forward(params, input_ids):
+        deq = {
+            k: dequantize(v, spec_dtype.get(k, jnp.float32))
+            for k, v in params.items()
+        }
+        return base_forward(deq, input_ids)
+
+    return dataclasses.replace(
+        dag,
+        graph=new_graph,
+        param_specs=new_specs,
+        init_fn=init_fn,
+        reference_forward=reference_forward,
+    )
