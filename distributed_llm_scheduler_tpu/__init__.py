@@ -46,6 +46,7 @@ from .core.schedule import Schedule, TaskTiming
 from .core.validate import ValidationReport, validate_schedule
 from .backends.sim import LinkModel, SimulatedBackend, TieredLinkModel
 from .sched.base import BaseScheduler
+from .sched.elastic import remainder_graph, reschedule, surviving_work
 from .sched.heft import HEFTScheduler
 from .sched.pack import GroupPackScheduler
 from .sched.pipeline import PipelineStageScheduler
@@ -58,6 +59,8 @@ from .sched.policies import (
     RoundRobinScheduler,
     get_scheduler,
 )
+from .sched.refine import RefinedPackScheduler
+from .utils.quantize import QParam, quantize_dag
 
 __version__ = "0.1.0"
 
@@ -85,8 +88,14 @@ __all__ = [
     "HEFTScheduler",
     "PipelineStageScheduler",
     "GroupPackScheduler",
+    "RefinedPackScheduler",
     "get_scheduler",
     "LinkModel",
     "TieredLinkModel",
     "SimulatedBackend",
+    "QParam",
+    "quantize_dag",
+    "surviving_work",
+    "remainder_graph",
+    "reschedule",
 ]

@@ -131,20 +131,28 @@ def rederive_shard_quants(params: Dict[str, Any]) -> Dict[str, Any]:
             continue
         off = 0
         base_shape = bq.q.shape
+
+        def _shape_of(v):
+            return tuple((v.q if isinstance(v, QParam) else v).shape)
+
         for _, name in entries:
-            if not isinstance(out.get(name), QParam):
+            if name not in out:
                 continue
-            shape = out[name].q.shape
+            shape = _shape_of(out[name])
             if shape[1:] == base_shape[1:]:  # row slice (tok_emb/wte)
-                out[name] = QParam(
-                    q=bq.q[off:off + shape[0]], scale=bq.scale
-                )
+                if isinstance(out[name], QParam):
+                    out[name] = QParam(
+                        q=bq.q[off:off + shape[0]], scale=bq.scale
+                    )
+                # advance even for fp shards: offsets are positional,
+                # not conditional on quantization
                 off += shape[0]
             elif shape[:-1] == base_shape[:-1]:  # column slice (lm_head)
-                out[name] = QParam(
-                    q=bq.q[..., off:off + shape[-1]],
-                    scale=bq.scale[..., off:off + shape[-1]],
-                )
+                if isinstance(out[name], QParam):
+                    out[name] = QParam(
+                        q=bq.q[..., off:off + shape[-1]],
+                        scale=bq.scale[..., off:off + shape[-1]],
+                    )
                 off += shape[-1]
     return out
 
@@ -176,8 +184,24 @@ def quantize_dag(dag: Any, min_elems: int = 4096) -> Any:
         name for name, spec in dag.param_specs.items()
         if should_quantize(spec, min_elems)
     }
+    # quantization is decided per SHARD GROUP, not per tensor: vocab
+    # shards must follow their base table (they carry slices of its
+    # quantized values — mixing fp shards with a quantized base would
+    # re-introduce the DAG-vs-oracle re-rounding divergence)
+    for base, entries in _shard_groups(dag.param_specs).items():
+        if base not in dag.param_specs:
+            continue
+        names = [n for _, n in entries]
+        if base in quantized:
+            quantized.update(names)
+        else:
+            quantized.difference_update(names)
+    # QParam specs are already quantized (re-application is a no-op for
+    # them); only float specs carry a dtype for the dequant shim
     spec_dtype = {
-        name: jnp.dtype(spec.dtype) for name, spec in dag.param_specs.items()
+        name: jnp.dtype(spec.dtype)
+        for name, spec in dag.param_specs.items()
+        if not isinstance(spec, QParam)
     }
 
     # wrap each distinct fn object once so structurally identical tasks

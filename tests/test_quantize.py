@@ -190,3 +190,40 @@ def test_untouched_tasks_keep_fn_identity(qsetup):
             assert qt.fn is t.fn, tid
         elif t.fn is not None:
             assert qt.fn is not t.fn, tid
+
+
+def test_shard_group_quantization_is_coherent():
+    """Shards follow their BASE table's quantization decision even when
+    individually below min_elems — mixing fp shards with a quantized base
+    would re-introduce DAG-vs-oracle re-rounding divergence."""
+    # V=512, D=128: base wte = 65536 elems; each of 8 shards = 8192... use
+    # min_elems high enough that shards alone wouldn't qualify
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=1, seq_len=16,
+                         vocab_shards=8)
+    qdag = quantize_dag(dag, min_elems=16_000)  # shards are 8192 < 16000
+    specs = qdag.param_specs
+    assert isinstance(specs["wte"], QParam)
+    for k in range(8):
+        assert isinstance(specs[f"wte_shard_{k}"], QParam), k
+    params = qdag.init_params()
+    ids = qdag.make_inputs()
+    cluster = Cluster.from_jax_devices(hbm_cap_gb=4.0)
+    schedule = get_scheduler("pack").schedule(qdag.graph, cluster)
+    rep = DeviceBackend(cluster).execute(qdag.graph, schedule, params, ids)
+    fused = qdag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(rep.output), np.asarray(fused), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_quantize_dag_idempotent(qsetup):
+    _, qdag = qsetup
+    again = quantize_dag(qdag)
+    # re-application is a no-op: same quantized spec set, same byte totals
+    for k, spec in qdag.param_specs.items():
+        assert isinstance(again.param_specs[k], QParam) == isinstance(
+            spec, QParam
+        ), k
+    assert (
+        again.graph.total_param_gb() == qdag.graph.total_param_gb()
+    )

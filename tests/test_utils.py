@@ -206,3 +206,11 @@ def test_export_chrome_trace_requires_timings(tmp_path):
     )
     with _pytest.raises(ValueError, match="no timings"):
         export_chrome_trace(schedule, str(tmp_path / "t.json"))
+
+
+def test_public_surface_resolves():
+    """Every name in __all__ must be importable from the package root."""
+    import distributed_llm_scheduler_tpu as dls
+
+    for name in dls.__all__:
+        assert getattr(dls, name, None) is not None, name
