@@ -1,0 +1,83 @@
+"""Decode-throughput measurement for the KV-cache generation path.
+
+Not part of the north-star bench contract (bench.py prints exactly one
+JSON line for the driver); this is the inference-side perf probe: tokens
+per second of the one-program `lax.scan` decode loop
+(:mod:`..models.decode`) on a real device.  Run directly::
+
+    python -m distributed_llm_scheduler_tpu.eval.decode_bench
+
+The whole generation (prefill + N decode steps) is a single jitted
+program, so the measurement is one fence-amortized timing of that program
+— tunnel round-trips are netted out the same way the cost model does it
+(``utils/costmodel``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def measure_decode(
+    config: Any = None,
+    batch: int = 8,
+    prompt_len: int = 512,
+    new_tokens: int = 64,
+    reps: int = 3,
+    key: Optional[jax.Array] = None,
+) -> Dict[str, float]:
+    """Greedy-generation throughput: {decode_tok_s, wall_s, ...}.
+
+    ``wall_s`` covers prefill + all decode steps (the end-to-end latency a
+    caller sees); ``decode_tok_s`` credits only the generated tokens.
+    """
+    from ..models import gpt2
+    from ..utils.costmodel import _fence_rtt, readback_fence, time_amortized
+
+    if config is None:
+        config = gpt2.GPT2Config.small(dtype=jnp.bfloat16)
+    key = key if key is not None else jax.random.PRNGKey(0)
+    params = gpt2.init_params(config, key)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, config.vocab_size,
+        dtype=jnp.int32,
+    )
+
+    out = gpt2.generate(params, ids, config, max_new_tokens=new_tokens)
+    readback_fence(out)  # compile + settle before timing
+    rtt = _fence_rtt(jax.devices()[0])
+    wall_s = max(
+        time_amortized(
+            lambda: gpt2.generate(
+                params, ids, config, max_new_tokens=new_tokens
+            ),
+            reps,
+            rtt,
+        ),
+        1e-9,
+    )
+    return {
+        "batch": float(batch),
+        "prompt_len": float(prompt_len),
+        "new_tokens": float(new_tokens),
+        "wall_s": wall_s,
+        "decode_tok_s": batch * new_tokens / wall_s,
+        "ms_per_token_step": wall_s / new_tokens * 1e3,
+    }
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    res = measure_decode()
+    print(json.dumps({k: round(v, 4) for k, v in res.items()}))
+    print(
+        f"decode: {res['decode_tok_s']:.0f} tok/s "
+        f"({res['ms_per_token_step']:.2f} ms/step, batch "
+        f"{int(res['batch'])}, prompt {int(res['prompt_len'])})",
+        file=sys.stderr,
+    )

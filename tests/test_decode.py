@@ -159,3 +159,17 @@ def test_sample_token_greedy_no_key():
     logits = jnp.array([[0.1, 2.0, -1.0], [3.0, 0.0, 0.0]])
     toks = decode.sample_token(logits, None, 0.0)
     assert toks.tolist() == [1, 0]
+
+
+def test_decode_bench_helper_runs():
+    """The throughput probe works on any backend (tiny config on CPU)."""
+    from distributed_llm_scheduler_tpu.eval.decode_bench import measure_decode
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    res = measure_decode(
+        config=GPT2Config.tiny(), batch=2, prompt_len=8, new_tokens=4,
+        reps=2,
+    )
+    assert res["decode_tok_s"] > 0
+    assert res["wall_s"] > 0
+    assert res["new_tokens"] == 4.0
