@@ -100,6 +100,19 @@ def _load_pretrained_weights(path: str, config, model_name: str):
     return params
 
 
+def _export_trace(schedule, path: str) -> int:
+    """Shared --trace export: 0 on success, 2 (with stderr) on failure."""
+    from .utils.profiling import export_chrome_trace
+
+    try:
+        print("trace ->", export_chrome_trace(schedule, path),
+              file=sys.stderr)
+        return 0
+    except ValueError as e:  # degenerate replay with no timed tasks
+        print(str(e), file=sys.stderr)
+        return 2
+
+
 def _replay_backend(cfg):
     """The sim backend the schedule/visualize replay commands accept; the
     device backend has a different execute() contract (live params/inputs)
@@ -137,15 +150,8 @@ def cmd_schedule(args) -> int:
         "cache_hit_rate": rep.cache_hit_rate,
         "load_balance": rep.load_balance_score,
     }, indent=1, default=str))
-    if args.trace:
-        from .utils.profiling import export_chrome_trace
-
-        try:
-            print("trace ->", export_chrome_trace(schedule, args.trace),
-                  file=sys.stderr)
-        except ValueError as e:  # degenerate replay with no timed tasks
-            print(str(e), file=sys.stderr)
-            return 2
+    if args.trace and _export_trace(schedule, args.trace):
+        return 2
     if args.save:
         print("graph ->", save_graph(graph, f"{cfg.out_dir}/{graph.name}.graph.json"))
         print("schedule ->", save_schedule(
@@ -231,15 +237,8 @@ def cmd_execute(args) -> int:
         segments=args.segments,
     )
     print(json.dumps(rep.summary(), indent=1, default=str))
-    if args.trace:
-        from .utils.profiling import export_chrome_trace
-
-        try:
-            print("trace ->", export_chrome_trace(schedule, args.trace),
-                  file=sys.stderr)
-        except ValueError as e:
-            print(str(e), file=sys.stderr)
-            return 2
+    if args.trace and _export_trace(schedule, args.trace):
+        return 2
     return 0
 
 
@@ -327,9 +326,11 @@ def cmd_generate(args) -> int:
               "mixtral*); synthetic graphs have no decode path",
               file=sys.stderr)
         return 2
+    # family resolution shared with the weights table (prefix match, not
+    # first letter: a future 'mistral-*' must not silently bind mixtral)
     mod = {
-        "g": gpt2, "l": llama, "m": mixtral,
-    }[args.model[0]]
+        "gpt2": gpt2, "llama": llama, "mixtral": mixtral,
+    }[_weights_family(args.model)]
 
     if args.weights:
         params = _load_pretrained_weights(args.weights, config, args.model)

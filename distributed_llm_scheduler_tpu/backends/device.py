@@ -65,6 +65,10 @@ class DeviceReport:
     # executable launches issued (== placed tasks per-task; == segments
     # under segment fusion)
     n_dispatches: int = 0
+    # execute(keep_outputs=True): per-task outputs retained for elastic
+    # recovery (every executed task per-task; segment exports under
+    # segment fusion).  Keys feed reschedule()/execute(ext_outputs=...)
+    task_outputs: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def total_param_gb_placed(self) -> float:
@@ -128,6 +132,39 @@ class DeviceBackend:
     def _fence_device(self):
         """The device the end-of-run fence reads back from."""
         return self.cluster.devices[0].jax_device
+
+    def _fence_run(
+        self, outputs: Dict[str, Any], last_on_device: Dict[str, Any]
+    ) -> int:
+        """Fence ALL dispatched work with ONE readback; returns the fence
+        count (1) to subtract as RTT.
+
+        ``block_until_ready`` first, then a combined readback fence:
+        block_until_ready is unreliable through the axon tunnel (it can
+        return before compute completes — utils/costmodel.readback_fence),
+        and per-device queues are FIFO, so one fenced value per device
+        proves that device's whole queue drained.  One element of each
+        device's last output is pulled onto the fence device and their
+        (dependent) combination read back — one RTT regardless of device
+        count; per-device sequential fences would over-subtract when an
+        early fence's round-trip overlaps a straggler device's remaining
+        compute.  Shared by the per-task and segment-fused paths so their
+        makespan measurements cannot drift.
+        """
+        from ..utils.costmodel import readback_fence
+
+        jax.block_until_ready(list(outputs.values()))
+        fence_dev = self._fence_device()
+        tips = []
+        for out in last_on_device.values():
+            leaf = jax.tree_util.tree_leaves(out)[-1]
+            tip = leaf[(0,) * leaf.ndim]
+            tips.append(jax.device_put(tip, fence_dev))
+        combined = tips[0]
+        for t in tips[1:]:
+            combined = combined + t.astype(combined.dtype)
+        readback_fence(combined)
+        return 1
 
     # -- placement ---------------------------------------------------------
     def place_params(
@@ -432,24 +469,18 @@ class DeviceBackend:
         # guard on executed segments, not `outputs` — ext_outputs seeds can
         # make `outputs` non-empty when nothing actually ran
         if last_on_device:
-            from ..utils.costmodel import readback_fence
-
-            jax.block_until_ready(list(outputs.values()))
-            fence_dev = self._fence_device()
-            tips = []
-            for out in last_on_device.values():
-                leaf = jax.tree_util.tree_leaves(out)[-1]
-                tip = leaf[(0,) * leaf.ndim]
-                tips.append(jax.device_put(tip, fence_dev))
-            combined = tips[0]
-            for t in tips[1:]:
-                combined = combined + t.astype(combined.dtype)
-            readback_fence(combined)
-            n_fences = 1
+            n_fences = self._fence_run(outputs, last_on_device)
         # same semantics as the per-task path: None when the graph's last
         # task didn't execute (callers detect incomplete runs by this)
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
-        return final, {}, transfer_edges, transfer_bytes, n_fences, len(segments)
+        executed = {
+            k: v for k, v in outputs.items()
+            if not ext_outputs or k not in ext_outputs
+        }
+        return (
+            final, {}, transfer_edges, transfer_bytes, n_fences,
+            len(segments), executed,
+        )
 
     # -- execution ---------------------------------------------------------
     def _run(
@@ -523,34 +554,19 @@ class DeviceBackend:
         # proves that device's whole queue drained.
         n_fences = 0
         if len(outputs) > n_ext:
-            from ..utils.costmodel import readback_fence
-
-            jax.block_until_ready(list(outputs.values()))
-            # ONE fence for the whole run: pull a single element of each
-            # device's last output onto the fence device and read back
-            # their (dependent) combination.  One RTT regardless of device
-            # count — per-device sequential fences would over-subtract
-            # when an early fence's round-trip overlaps a straggler
-            # device's remaining compute.
             last_on_device: Dict[str, Any] = {}
             for tid in order:
                 if tid in outputs:
                     last_on_device[placement[tid]] = outputs[tid]
-            fence_dev = self._fence_device()
-            tips = []
-            for out in last_on_device.values():
-                leaf = jax.tree_util.tree_leaves(out)[-1]
-                tip = leaf[(0,) * leaf.ndim]
-                tips.append(jax.device_put(tip, fence_dev))
-            combined = tips[0]
-            for t in tips[1:]:
-                combined = combined + t.astype(combined.dtype)
-            readback_fence(combined)
-            n_fences = 1
+            n_fences = self._fence_run(outputs, last_on_device)
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
+        executed = {
+            k: v for k, v in outputs.items()
+            if not ext_outputs or k not in ext_outputs
+        }
         return (
             final, timings, transfer_edges, transfer_bytes, n_fences,
-            len(outputs) - n_ext,
+            len(outputs) - n_ext, executed,
         )
 
     def execute(
@@ -563,6 +579,7 @@ class DeviceBackend:
         warmup: bool = True,
         segments: bool = False,
         ext_outputs: Optional[Dict[str, Any]] = None,
+        keep_outputs: bool = False,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
@@ -572,6 +589,15 @@ class DeviceBackend:
         tasks that survived a node failure.  Keys are the external task
         ids; values are host or device arrays (transferred to the
         consuming core on use).
+
+        ``keep_outputs=True`` retains per-task outputs on the report
+        (``task_outputs``) so a LATER failure can recover without
+        recomputation: pass the surviving subset to ``surviving_work``'s
+        ``have_outputs`` and to the re-execution's ``ext_outputs``.
+        Per-task dispatch keeps every executed task's output; segment
+        fusion keeps segment exports only (internal values never left
+        their fused program).  Costs device memory proportional to
+        activations held.
 
         ``profile=True`` records per-task wall times via per-task
         ``block_until_ready`` (Gantt charts / diagnostics).  CAVEAT: on the
@@ -622,14 +648,17 @@ class DeviceBackend:
 
         t0 = time.perf_counter()
         if segments:
-            output, timings, tedges, tbytes, n_fences, n_disp = (
+            output, timings, tedges, tbytes, n_fences, n_disp, touts = (
                 self._run_segmented(
                     graph, schedule, placed, graph_input, ext_outputs
                 )
             )
         else:
-            output, timings, tedges, tbytes, n_fences, n_disp = self._run(
-                graph, schedule, placed, graph_input, profile, ext_outputs
+            output, timings, tedges, tbytes, n_fences, n_disp, touts = (
+                self._run(
+                    graph, schedule, placed, graph_input, profile,
+                    ext_outputs,
+                )
             )
         wall = time.perf_counter() - t0
         makespan = max(wall - n_fences * rtt, 1e-9)
@@ -657,4 +686,5 @@ class DeviceBackend:
             timings=timings,
             peak_hbm_bytes=peaks,
             n_dispatches=n_disp,
+            task_outputs=touts if keep_outputs else {},
         )

@@ -31,41 +31,55 @@ def measure_decode(
 ) -> Dict[str, float]:
     """Greedy-generation throughput: {decode_tok_s, wall_s, ...}.
 
-    ``wall_s`` covers prefill + all decode steps (the end-to-end latency a
-    caller sees); ``decode_tok_s`` credits only the generated tokens.
+    ``config`` may be any family's config (gpt2 / llama / mixtral — the
+    module is resolved like :mod:`..parallel.decode` does).  ``wall_s``
+    covers prefill + all decode steps (the end-to-end latency a caller
+    sees).  Per-step cost is measured by DIFFERENCING two generation
+    lengths — (wall(N) - wall(1)) / (N - 1) — so the prefill's cost
+    cannot inflate the reported step latency; ``decode_tok_s`` derives
+    from that differenced time.
     """
-    from ..models import gpt2
+    from ..parallel.decode import _family_of, _module_for
     from ..utils.costmodel import _fence_rtt, readback_fence, time_amortized
 
     if config is None:
-        config = gpt2.GPT2Config.small(dtype=jnp.bfloat16)
+        from ..models.gpt2 import GPT2Config
+
+        config = GPT2Config.small(dtype=jnp.bfloat16)
+    if new_tokens < 2:
+        raise ValueError("new_tokens must be >= 2 to difference out prefill")
+    mod = _module_for(_family_of(config))
     key = key if key is not None else jax.random.PRNGKey(0)
-    params = gpt2.init_params(config, key)
+    params = mod.init_params(config, key)
     ids = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, config.vocab_size,
         dtype=jnp.int32,
     )
 
-    out = gpt2.generate(params, ids, config, max_new_tokens=new_tokens)
-    readback_fence(out)  # compile + settle before timing
-    rtt = _fence_rtt(jax.devices()[0])
-    wall_s = max(
-        time_amortized(
-            lambda: gpt2.generate(
-                params, ids, config, max_new_tokens=new_tokens
+    def timed(n: int) -> float:
+        out = mod.generate(params, ids, config, max_new_tokens=n)
+        readback_fence(out)  # compile + settle before timing
+        rtt = _fence_rtt(jax.devices()[0])
+        return max(
+            time_amortized(
+                lambda: mod.generate(params, ids, config, max_new_tokens=n),
+                reps,
+                rtt,
             ),
-            reps,
-            rtt,
-        ),
-        1e-9,
-    )
+            1e-9,
+        )
+
+    wall_1 = timed(1)  # prefill + one step
+    wall_s = timed(new_tokens)
+    step_s = max((wall_s - wall_1) / (new_tokens - 1), 1e-9)
     return {
         "batch": float(batch),
         "prompt_len": float(prompt_len),
         "new_tokens": float(new_tokens),
         "wall_s": wall_s,
-        "decode_tok_s": batch * new_tokens / wall_s,
-        "ms_per_token_step": wall_s / new_tokens * 1e3,
+        "prefill_plus_one_s": wall_1,
+        "decode_tok_s": batch / step_s,
+        "ms_per_token_step": step_s * 1e3,
     }
 
 

@@ -45,6 +45,7 @@ def surviving_work(
     schedule: Schedule,
     completed: Iterable[str],
     dead_nodes: Iterable[str],
+    have_outputs: Optional[Iterable[str]] = None,
 ) -> Tuple[Set[str], Set[str]]:
     """Split tasks into (must_run, available) after node failures.
 
@@ -54,6 +55,13 @@ def surviving_work(
     fed in via ``DeviceBackend.execute(ext_outputs=...)``).
     ``must_run``: everything else — incomplete tasks and completed tasks
     whose outputs sat on dead nodes.
+
+    ``have_outputs``: the task ids whose output values the caller actually
+    retained (``DeviceBackend.execute(keep_outputs=True)`` ->
+    ``DeviceReport.task_outputs``).  Completed-on-survivor tasks whose
+    values were NOT kept (e.g. segment-internal values under fused
+    dispatch) re-run too — availability means "I can hand its bytes to
+    ext_outputs", not just "it once finished".
     """
     dead = set(dead_nodes)
     placement = schedule.placement
@@ -62,6 +70,8 @@ def surviving_work(
         t for t in done if placement.get(t) is not None
         and placement[t] not in dead
     }
+    if have_outputs is not None:
+        available &= set(have_outputs)
     # a completed-on-survivor task whose output feeds a re-running consumer
     # is still available (its output is alive); only dead-node outputs are
     # gone.  must_run closure: start from non-available, propagate nothing —
@@ -117,6 +127,7 @@ def reschedule(
     dead_nodes: Iterable[str],
     cluster: Cluster,
     scheduler,
+    have_outputs: Optional[Iterable[str]] = None,
 ) -> Tuple[Schedule, Set[str], Set[str]]:
     """Re-place everything that must (re-)run after ``dead_nodes`` fail.
 
@@ -127,6 +138,8 @@ def reschedule(
       dead_nodes: node_ids lost (their HBM contents with them).
       cluster: the surviving cluster (must not contain dead nodes).
       scheduler: any policy instance (``get_scheduler(...)``).
+      have_outputs: retained output ids (``DeviceReport.task_outputs``
+        from ``execute(keep_outputs=True)``); see :func:`surviving_work`.
 
     Returns ``(new_schedule, must_run, available)``.
     """
@@ -136,7 +149,9 @@ def reschedule(
         raise ValueError(
             f"surviving cluster still contains dead nodes {still_dead}"
         )
-    must_run, available = surviving_work(graph, schedule, completed, dead)
+    must_run, available = surviving_work(
+        graph, schedule, completed, dead, have_outputs
+    )
     sub = remainder_graph(graph, must_run)
     new_schedule = scheduler.schedule(sub, cluster)
     return new_schedule, must_run, available

@@ -9,9 +9,15 @@ overlap.  This policy closes that gap with plain hill climbing:
 1. seed with pack's LPT group placement;
 2. repeatedly propose **moves** (bottleneck-device group -> elsewhere) and
    **swaps** (bottleneck group <-> lighter-device group), scoring each
-   candidate with the same event simulation the ordering pass and the
-   replay use (:func:`..sched.eventsim.simulate_placement`) — the search
-   optimizes the objective it is judged on, not a proxy;
+   candidate with the event simulation the ordering pass uses
+   (:func:`..sched.eventsim.simulate_placement`).  This is a close
+   SURROGATE of the replay's objective — same link charges, per-node
+   serial execution, prefetch queue — but not the replay loop itself
+   (``backends/sim.py`` additionally models host dispatch slots and its
+   own cache accounting), so improvement under the surrogate is
+   guaranteed only against the surrogate; in practice the two move
+   together (tests pin refine <= pack under the replay on the covered
+   graphs, and the flagship bench confirms it end-to-end);
 3. first-improvement acceptance, stop when a full neighborhood pass finds
    nothing better or the evaluation budget runs out;
 4. commit through pack's assignment path (same memory checks, same

@@ -49,8 +49,8 @@ class RunConfig:
     out_dir: str = "evaluation_results"
     seed: int = 0
     # optional pretrained weights for `execute`: a torch state-dict file
-    # (GPT-2 family; frontend/pretrained.py name-maps it) — random init
-    # when unset
+    # (gpt2 / llama / mixtral families; frontend/pretrained.py name-maps
+    # it) — random init when unset
     weights: Optional[str] = None
 
     def _model_family(self):

@@ -98,23 +98,12 @@ def test_no_failure_reschedules_only_incomplete(run_state):
     assert must_run == {t.task_id for t in graph.tasks()} - completed
 
 
-def _host_outputs(graph, params, graph_input):
-    """Reference per-task outputs computed by walking the DAG on host."""
-    vals = {}
-    for tid in graph.topo_order:
-        t = graph[tid]
-        pd = {loc: params[g] for loc, g in t.param_items()}
-        aids = t.arg_tasks or t.dependencies
-        args = [vals[d] for d in aids] if aids else [graph_input]
-        vals[tid] = t.fn(pd, *args)
-    return vals
-
-
 @pytest.mark.parametrize("segments", [False, True])
 def test_device_recovery_end_to_end(segments):
-    """The headline: kill a node mid-run, reschedule the remainder on the
-    survivors, feed the surviving outputs via ext_outputs, and the final
-    logits match the fused forward exactly."""
+    """The headline, via the PUBLIC flow: a first run retains outputs
+    (keep_outputs=True), a node dies, reschedule() consumes the report's
+    task_outputs, and re-execution with ext_outputs reproduces the fused
+    forward exactly — no host-side recomputation anywhere."""
     import jax
     import numpy as np
 
@@ -127,6 +116,11 @@ def test_device_recovery_end_to_end(segments):
     params, ids = dag.init_params(), dag.make_inputs()
     cluster = Cluster.from_jax_devices(jax.devices()[:4], hbm_cap_gb=8.0)
     schedule = get_scheduler("pack").schedule(graph, cluster)
+    first = DeviceBackend(cluster).execute(
+        graph, schedule, params, ids, segments=segments, keep_outputs=True
+    )
+    assert first.task_outputs  # retention is what makes recovery drivable
+    # "mid-run" state: the first half of the assignment order finished
     order = schedule.assignment_order
     completed = set(order[: len(order) // 2])
     dead = cluster.devices[2].node_id
@@ -141,11 +135,12 @@ def test_device_recovery_end_to_end(segments):
     ])
     new_s, must_run, available = reschedule(
         graph, schedule, completed, {dead}, survivors,
-        get_scheduler("pack"),
+        get_scheduler("pack"), have_outputs=first.task_outputs,
     )
     assert not new_s.failed
-    host = _host_outputs(graph, params, ids)
-    ext = {tid: host[tid] for tid in available}
+    # available is exactly what we can feed: completed, on survivors, and
+    # actually retained (segment mode retains exports only)
+    ext = {tid: first.task_outputs[tid] for tid in available}
     rep = DeviceBackend(survivors).execute(
         remainder_graph(graph, must_run), new_s, params, ids,
         ext_outputs=ext, segments=segments,
