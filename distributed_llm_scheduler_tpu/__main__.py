@@ -232,14 +232,115 @@ def cmd_execute(args) -> int:
     else:
         params = dag.init_params()
     ids = dag.make_inputs()
+    inject = None
+    if args.inject_failure:
+        # validate the spec BEFORE the expensive device run
+        inject = _parse_injection(args.inject_failure, cluster)
+        if inject is None:
+            return 2
     rep = backend.execute(
         dag.graph, schedule, params, ids, profile=args.profile,
-        segments=args.segments,
+        segments=args.segments, keep_outputs=bool(inject),
     )
-    print(json.dumps(rep.summary(), indent=1, default=str))
+    summary = rep.summary()
+    if inject:
+        recovery = _injected_recovery(
+            inject, dag, schedule, cluster, cfg, rep, params, ids,
+            segments=args.segments,
+        )
+        summary["recovery"] = recovery
+        print(json.dumps(summary, indent=1, default=str))
+        if not recovery["output_matches_uninterrupted"]:
+            # a failed recovery must be scriptable, not buried in JSON
+            print("--inject-failure: recovered output does NOT match the "
+                  "uninterrupted run", file=sys.stderr)
+            return 1
+    else:
+        print(json.dumps(summary, indent=1, default=str))
     if args.trace and _export_trace(schedule, args.trace):
         return 2
     return 0
+
+
+def _parse_injection(spec: str, cluster):
+    """Validate `--inject-failure NODE[:FRAC]`; (node_id, frac) or None."""
+    node, _, frac_s = spec.partition(":")
+    try:
+        frac = float(frac_s) if frac_s else 0.5
+    except ValueError:
+        print(f"--inject-failure: bad fraction {frac_s!r}", file=sys.stderr)
+        return None
+    if not 0.0 <= frac <= 1.0:
+        print(f"--inject-failure: fraction {frac} outside [0, 1]",
+              file=sys.stderr)
+        return None
+    if node.isdigit():
+        idx = int(node)
+        if idx >= len(cluster):
+            print(f"--inject-failure: node index {idx} out of range "
+                  f"(cluster has {len(cluster)} devices)", file=sys.stderr)
+            return None
+        node = cluster.devices[idx].node_id
+    if node not in cluster:
+        print(f"--inject-failure: unknown node {node!r} "
+              f"(have {cluster.ids()})", file=sys.stderr)
+        return None
+    if len(cluster) < 2:
+        print("--inject-failure needs >= 2 devices", file=sys.stderr)
+        return None
+    return node, frac
+
+
+def _injected_recovery(
+    inject, dag, schedule, cluster, cfg, first_rep, params, ids,
+    segments: bool,
+):
+    """Fault injection for `execute --inject-failure NODE[:FRAC]`: treat
+    the first FRAC of the assignment order as completed when NODE dies,
+    re-place the remainder on the survivors, re-execute feeding the
+    retained surviving outputs, and verify the recovered output matches
+    the uninterrupted run.  Returns the recovery summary dict."""
+    import numpy as np
+
+    from .backends.device import DeviceBackend
+    from .core.cluster import Cluster, DeviceState
+    from .sched.elastic import remainder_graph, reschedule
+
+    node, frac = inject
+    order = schedule.assignment_order
+    completed = set(order[: int(len(order) * frac)])
+    survivors = Cluster([
+        DeviceState(d.node_id, d.total_memory, d.compute_speed,
+                    jax_device=d.jax_device, slice_id=d.slice_id)
+        for d in cluster if d.node_id != node
+    ])
+    new_s, must_run, available = reschedule(
+        dag.graph, schedule, completed, {node}, survivors,
+        cfg.build_scheduler(), have_outputs=first_rep.task_outputs,
+    )
+    ext = {t: first_rep.task_outputs[t] for t in available}
+    rec = DeviceBackend(survivors).execute(
+        remainder_graph(dag.graph, must_run), new_s, params, ids,
+        ext_outputs=ext, segments=segments,
+    )
+    # the graph's final task may itself have survived the failure — its
+    # retained output IS the recovered result then
+    final = dag.graph.topo_order[-1]
+    recovered_final = ext[final] if final in available else rec.output
+    ok = first_rep.output is not None and recovered_final is not None and (
+        bool(np.allclose(
+            np.asarray(first_rep.output), np.asarray(recovered_final),
+            rtol=2e-4, atol=2e-4,
+        ))
+    )
+    return {
+        "killed_node": node,
+        "completed_before_failure": len(completed),
+        "reused_outputs": len(ext),
+        "rerun_tasks": len(must_run),
+        "recovered_makespan_ms": rec.makespan_s * 1e3,
+        "output_matches_uninterrupted": ok,
+    }
 
 
 def cmd_visualize(args) -> int:
@@ -420,6 +521,12 @@ def main(argv=None) -> int:
     p.add_argument("--trace", default=None,
                    help="write measured task timeline (needs --profile) as "
                         "a Chrome/Perfetto trace JSON to this path")
+    p.add_argument("--inject-failure", default=None, metavar="NODE[:FRAC]",
+                   dest="inject_failure",
+                   help="fault injection: kill NODE (id or index) after "
+                        "FRAC (default 0.5) of the run, reschedule the "
+                        "remainder on the survivors with retained outputs, "
+                        "and verify the recovered result")
     p.add_argument("--weights", default=None,
                    help="torch state-dict file with pretrained GPT-2 / "
                         "Llama / Mixtral weights (HF layout); random "

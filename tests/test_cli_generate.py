@@ -100,3 +100,60 @@ def test_generate_with_pretrained_weights(tmp_path):
     r2 = _run("--model", "gpt2-tiny", "--weights", path,
               "--prompt-ids", "1,2,3", "--max-new-tokens", "3")
     assert json.loads(r2.stdout.strip().splitlines()[-1]) == out
+
+
+def test_execute_inject_failure_recovers():
+    """CLI fault injection: kill a node mid-run, recover on survivors."""
+    env = dict(
+        os.environ,
+        DLS_PLATFORM="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
+         "--model", "gpt2-tiny", "--num-nodes", "4", "--scheduler", "pack",
+         "--inject-failure", "1:0.4"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=400,
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    rec = out["recovery"]
+    assert rec["output_matches_uninterrupted"] is True
+    assert rec["rerun_tasks"] > 0
+    assert rec["reused_outputs"] > 0
+
+
+def test_execute_inject_failure_rejects_unknown_node():
+    env = dict(
+        os.environ,
+        DLS_PLATFORM="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
+         "--model", "gpt2-tiny", "--num-nodes", "4",
+         "--inject-failure", "nope"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=400,
+    )
+    assert r.returncode == 2
+    assert "unknown node" in r.stderr
+
+
+def test_execute_inject_failure_full_completion_edge():
+    """FRAC=1.0: everything completed before the failure; only the dead
+    node's (lost) outputs re-run, and verification uses the retained final
+    output when the final task survived."""
+    env = dict(
+        os.environ,
+        DLS_PLATFORM="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
+         "--model", "gpt2-tiny", "--num-nodes", "4", "--scheduler", "pack",
+         "--inject-failure", "1:1.0"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=400,
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout)["recovery"]
+    assert rec["output_matches_uninterrupted"] is True

@@ -129,7 +129,7 @@ def test_device_recovery_end_to_end(segments):
     survivors = Cluster([
         DeviceState(
             d.node_id, d.total_memory, d.compute_speed,
-            jax_device=d.jax_device,
+            jax_device=d.jax_device, slice_id=d.slice_id,
         )
         for d in cluster if d.node_id != dead
     ])
