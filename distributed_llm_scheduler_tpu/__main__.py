@@ -252,8 +252,12 @@ def cmd_execute(args) -> int:
         print(json.dumps(summary, indent=1, default=str))
         if not recovery["output_matches_uninterrupted"]:
             # a failed recovery must be scriptable, not buried in JSON
-            print("--inject-failure: recovered output does NOT match the "
-                  "uninterrupted run", file=sys.stderr)
+            msg = (
+                "remainder could not be placed on the survivors"
+                if "reschedule_failed_tasks" in recovery
+                else "recovered output does NOT match the uninterrupted run"
+            )
+            print(f"--inject-failure: {msg}", file=sys.stderr)
             return 1
     else:
         print(json.dumps(summary, indent=1, default=str))
@@ -303,44 +307,49 @@ def _injected_recovery(
     import numpy as np
 
     from .backends.device import DeviceBackend
-    from .core.cluster import Cluster, DeviceState
-    from .sched.elastic import remainder_graph, reschedule
+    from .sched.elastic import reschedule
 
     node, frac = inject
     order = schedule.assignment_order
     completed = set(order[: int(len(order) * frac)])
-    survivors = Cluster([
-        DeviceState(d.node_id, d.total_memory, d.compute_speed,
-                    jax_device=d.jax_device, slice_id=d.slice_id)
-        for d in cluster if d.node_id != node
-    ])
-    new_s, must_run, available = reschedule(
+    survivors = cluster.without(node)
+    new_s, remainder, must_run, available = reschedule(
         dag.graph, schedule, completed, {node}, survivors,
         cfg.build_scheduler(), have_outputs=first_rep.task_outputs,
     )
+    summary = {
+        "killed_node": node,
+        "completed_before_failure": len(completed),
+        "reused_outputs": len(available),
+        "rerun_tasks": len(must_run),
+    }
+    if new_s.failed:
+        # distinguish "remainder would not fit on the survivors" from a
+        # numerical recovery failure
+        summary["reschedule_failed_tasks"] = len(new_s.failed)
+        summary["output_matches_uninterrupted"] = False
+        return summary
     ext = {t: first_rep.task_outputs[t] for t in available}
     rec = DeviceBackend(survivors).execute(
-        remainder_graph(dag.graph, must_run), new_s, params, ids,
-        ext_outputs=ext, segments=segments,
+        remainder, new_s, params, ids,
+        ext_outputs=ext, segments=segments, keep_outputs=True,
     )
-    # the graph's final task may itself have survived the failure — its
-    # retained output IS the recovered result then
+    # compare the ORIGINAL graph's final task: retained if it survived the
+    # failure, recomputed (rec.task_outputs) otherwise — rec.output is the
+    # remainder's own last task, which need not be the model's output
     final = dag.graph.topo_order[-1]
-    recovered_final = ext[final] if final in available else rec.output
+    recovered_final = (
+        ext[final] if final in available else rec.task_outputs.get(final)
+    )
     ok = first_rep.output is not None and recovered_final is not None and (
         bool(np.allclose(
             np.asarray(first_rep.output), np.asarray(recovered_final),
             rtol=2e-4, atol=2e-4,
         ))
     )
-    return {
-        "killed_node": node,
-        "completed_before_failure": len(completed),
-        "reused_outputs": len(ext),
-        "rerun_tasks": len(must_run),
-        "recovered_makespan_ms": rec.makespan_s * 1e3,
-        "output_matches_uninterrupted": ok,
-    }
+    summary["recovered_makespan_ms"] = rec.makespan_s * 1e3
+    summary["output_matches_uninterrupted"] = ok
+    return summary
 
 
 def cmd_visualize(args) -> int:

@@ -166,6 +166,20 @@ class Cluster:
             for i in range(cores_per_slice)
         ])
 
+    def without(self, *node_ids: str) -> "Cluster":
+        """A new cluster of fresh DeviceStates minus ``node_ids`` — the
+        survivor set after failures (elastic recovery).  Copies every
+        identity field (incl. jax_device binding and slice topology) so
+        callers can't drift by hand-rebuilding DeviceStates."""
+        dead = set(node_ids)
+        return Cluster([
+            DeviceState(
+                d.node_id, d.total_memory, d.compute_speed,
+                jax_device=d.jax_device, slice_id=d.slice_id,
+            )
+            for d in self.devices if d.node_id not in dead
+        ])
+
     def slice_ids(self) -> Dict[str, int]:
         """node_id -> slice_id (for topology-aware cost call sites)."""
         return {d.node_id: d.slice_id for d in self.devices}

@@ -141,7 +141,9 @@ def reschedule(
       have_outputs: retained output ids (``DeviceReport.task_outputs``
         from ``execute(keep_outputs=True)``); see :func:`surviving_work`.
 
-    Returns ``(new_schedule, must_run, available)``.
+    Returns ``(new_schedule, remainder, must_run, available)`` — the
+    remainder graph IS the one the schedule was computed over; execute
+    that same object rather than rebuilding it.
     """
     dead = set(dead_nodes)
     still_dead = [d.node_id for d in cluster if d.node_id in dead]
@@ -154,4 +156,4 @@ def reschedule(
     )
     sub = remainder_graph(graph, must_run)
     new_schedule = scheduler.schedule(sub, cluster)
-    return new_schedule, must_run, available
+    return new_schedule, sub, must_run, available

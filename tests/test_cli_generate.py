@@ -55,7 +55,8 @@ def test_execute_rejects_weights_for_synthetic_model():
     )
     r = subprocess.run(
         [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
-         "--model", "llm", "--weights", "/nonexistent.pt"],
+         "--model", "llm", "--weights", "/nonexistent.pt",
+         "--batch", "1", "--seq-len", "16"],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
     )
     assert r.returncode == 2
@@ -112,6 +113,7 @@ def test_execute_inject_failure_recovers():
     r = subprocess.run(
         [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
          "--model", "gpt2-tiny", "--num-nodes", "4", "--scheduler", "pack",
+         "--batch", "1", "--seq-len", "16",
          "--inject-failure", "1:0.4"],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=400,
     )
@@ -132,6 +134,7 @@ def test_execute_inject_failure_rejects_unknown_node():
     r = subprocess.run(
         [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
          "--model", "gpt2-tiny", "--num-nodes", "4",
+         "--batch", "1", "--seq-len", "16",
          "--inject-failure", "nope"],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=400,
     )
@@ -151,6 +154,7 @@ def test_execute_inject_failure_full_completion_edge():
     r = subprocess.run(
         [sys.executable, "-m", "distributed_llm_scheduler_tpu", "execute",
          "--model", "gpt2-tiny", "--num-nodes", "4", "--scheduler", "pack",
+         "--batch", "1", "--seq-len", "16",
          "--inject-failure", "1:1.0"],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=400,
     )

@@ -2,7 +2,7 @@
 
 import pytest
 
-from distributed_llm_scheduler_tpu import Cluster, DeviceState, get_scheduler
+from distributed_llm_scheduler_tpu import Cluster, get_scheduler
 from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
 from distributed_llm_scheduler_tpu.frontend.generators import generate_llm_dag
 from distributed_llm_scheduler_tpu.sched.elastic import (
@@ -24,11 +24,7 @@ def run_state():
     order = schedule.assignment_order
     completed = set(order[: len(order) // 2])
     dead = cluster.devices[2].node_id
-    survivors = Cluster(
-        [DeviceState(d.node_id, d.total_memory, d.compute_speed)
-         for d in cluster if d.node_id != dead]
-    )
-    return graph, schedule, completed, dead, survivors
+    return graph, schedule, completed, dead, cluster.without(dead)
 
 
 def test_surviving_work_partition(run_state):
@@ -64,15 +60,14 @@ def test_remainder_graph_prunes_satisfied_deps(run_state):
 
 def test_reschedule_completes_on_survivors(run_state):
     graph, schedule, completed, dead, survivors = run_state
-    new_s, must_run, available = reschedule(
+    new_s, sub, must_run, available = reschedule(
         graph, schedule, completed, {dead}, survivors,
         get_scheduler("pack"),
     )
     assert not new_s.failed
     assert set(new_s.placement) == must_run
     assert dead not in new_s.per_node
-    # replay the remainder to confirm it actually executes
-    sub = remainder_graph(graph, must_run)
+    # replay the returned remainder to confirm it actually executes
     rep = SimulatedBackend(fidelity="full").execute(sub, survivors, new_s)
     assert rep.completed_tasks == len(must_run)
     # recovered run's total coverage equals the full task set
@@ -124,16 +119,10 @@ def test_device_recovery_end_to_end(segments):
     order = schedule.assignment_order
     completed = set(order[: len(order) // 2])
     dead = cluster.devices[2].node_id
-    # survivors keep their original node ids (a fresh from_jax_devices
-    # would renumber and resurrect the dead name)
-    survivors = Cluster([
-        DeviceState(
-            d.node_id, d.total_memory, d.compute_speed,
-            jax_device=d.jax_device, slice_id=d.slice_id,
-        )
-        for d in cluster if d.node_id != dead
-    ])
-    new_s, must_run, available = reschedule(
+    # survivors keep their original node ids, jax bindings, and slice
+    # topology (Cluster.without copies identity fields)
+    survivors = cluster.without(dead)
+    new_s, remainder, must_run, available = reschedule(
         graph, schedule, completed, {dead}, survivors,
         get_scheduler("pack"), have_outputs=first.task_outputs,
     )
@@ -142,7 +131,7 @@ def test_device_recovery_end_to_end(segments):
     # actually retained (segment mode retains exports only)
     ext = {tid: first.task_outputs[tid] for tid in available}
     rep = DeviceBackend(survivors).execute(
-        remainder_graph(graph, must_run), new_s, params, ids,
+        remainder, new_s, params, ids,
         ext_outputs=ext, segments=segments,
     )
     fused = dag.reference_forward(params, ids)
