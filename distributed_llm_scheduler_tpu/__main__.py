@@ -193,6 +193,10 @@ def cmd_execute(args) -> int:
         print("--trace needs per-task timings; add --profile",
               file=sys.stderr)
         return 2
+    if args.stream_params and args.segments:
+        print("--stream-params needs per-task dispatch; drop --segments",
+              file=sys.stderr)
+        return 2
     if cfg.slices > 1:
         # live clusters carry their REAL slice topology (from_jax_devices
         # reads device.slice_index); an artificial --slices would silently
@@ -241,12 +245,13 @@ def cmd_execute(args) -> int:
     rep = backend.execute(
         dag.graph, schedule, params, ids, profile=args.profile,
         segments=args.segments, keep_outputs=bool(inject),
+        stream_params=args.stream_params,
     )
     summary = rep.summary()
     if inject:
         recovery = _injected_recovery(
             inject, dag, schedule, cluster, cfg, rep, params, ids,
-            segments=args.segments,
+            segments=args.segments, stream_params=args.stream_params,
         )
         summary["recovery"] = recovery
         print(json.dumps(summary, indent=1, default=str))
@@ -297,7 +302,7 @@ def _parse_injection(spec: str, cluster):
 
 def _injected_recovery(
     inject, dag, schedule, cluster, cfg, first_rep, params, ids,
-    segments: bool,
+    segments: bool, stream_params: bool = False,
 ):
     """Fault injection for `execute --inject-failure NODE[:FRAC]`: treat
     the first FRAC of the assignment order as completed when NODE dies,
@@ -333,6 +338,7 @@ def _injected_recovery(
     rec = DeviceBackend(survivors).execute(
         remainder, new_s, params, ids,
         ext_outputs=ext, segments=segments, keep_outputs=True,
+        stream_params=stream_params,
     )
     # compare the ORIGINAL graph's final task: retained if it survived the
     # failure, recomputed (rec.task_outputs) otherwise — rec.output is the
@@ -530,6 +536,11 @@ def main(argv=None) -> int:
     p.add_argument("--trace", default=None,
                    help="write measured task timeline (needs --profile) as "
                         "a Chrome/Perfetto trace JSON to this path")
+    p.add_argument("--stream-params", action="store_true",
+                   dest="stream_params",
+                   help="load params on demand with LRU eviction under "
+                        "each node's HBM budget — executes models whose "
+                        "weights exceed the budget (bandwidth for capacity)")
     p.add_argument("--inject-failure", default=None, metavar="NODE[:FRAC]",
                    dest="inject_failure",
                    help="fault injection: kill NODE (id or index) after "

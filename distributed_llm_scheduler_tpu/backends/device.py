@@ -69,6 +69,10 @@ class DeviceReport:
     # recovery (every executed task per-task; segment exports under
     # segment fusion).  Keys feed reschedule()/execute(ext_outputs=...)
     task_outputs: Dict[str, Any] = field(default_factory=dict)
+    # execute(stream_params=True): streaming statistics
+    param_loads: int = 0
+    param_evictions: int = 0
+    peak_param_bytes: Dict[str, int] = field(default_factory=dict)
 
     @property
     def total_param_gb_placed(self) -> float:
@@ -87,6 +91,18 @@ class DeviceReport:
             "peak_hbm_gb": {
                 k: v / 1024**3 for k, v in self.peak_hbm_bytes.items()
             },
+            **(
+                {
+                    "param_loads": self.param_loads,
+                    "param_evictions": self.param_evictions,
+                    "peak_param_gb": {
+                        k: v / 1024**3
+                        for k, v in self.peak_param_bytes.items()
+                    },
+                }
+                if self.param_loads
+                else {}
+            ),
         }
 
 
@@ -195,6 +211,91 @@ class DeviceBackend:
         jax.block_until_ready(list(placed.values()))
         return placed, bytes_per_node
 
+    # -- parameter streaming ----------------------------------------------
+    class _ParamStreamer:
+        """On-demand parameter residency with LRU eviction under a per-node
+        HBM budget — the reference's param-cache/eviction model (reference
+        ``schedulers.py:404-442``) made PHYSICAL: a node whose weights
+        exceed its budget loads each param at first use and evicts the
+        least-recently-used resident to make room, so a model larger than
+        a device's HBM still executes (slower — streaming trades bandwidth
+        for capacity, exactly the constraint the scheduler's policies
+        optimize around).
+
+        Eviction safety under async dispatch: a buffer may still feed
+        queued ops, so before deleting anything on a node we fence that
+        node's most recent output — per-device queues are FIFO, so one
+        barrier proves every prior consumer finished.
+        """
+
+        def __init__(self, cluster: Cluster, params: Dict[str, Any]):
+            self.cluster = cluster
+            self.host_params = params
+            self.resident: Dict[str, Dict[str, Any]] = {
+                d.node_id: {} for d in cluster
+            }
+            self.bytes: Dict[str, int] = {d.node_id: 0 for d in cluster}
+            self.peak: Dict[str, int] = {d.node_id: 0 for d in cluster}
+            self.budget: Dict[str, int] = {
+                d.node_id: int(d.total_memory * 1024**3) for d in cluster
+            }
+            self.last_use: Dict[str, Dict[str, int]] = {
+                d.node_id: {} for d in cluster
+            }
+            self.last_output: Dict[str, Any] = {}
+            self.loads = 0
+            self.evictions = 0
+            self._step = 0
+
+        def note_output(self, node_id: str, out: Any) -> None:
+            self.last_output[node_id] = out
+
+        def get(self, name: str, node_id: str, pinned: set) -> Any:
+            self._step += 1
+            res = self.resident[node_id]
+            if name in res:
+                self.last_use[node_id][name] = self._step
+                return res[name]
+            need = _array_bytes(self.host_params[name])
+            fenced = False
+            while (
+                self.bytes[node_id] + need > self.budget[node_id] and res
+            ):
+                lru = self.last_use[node_id]
+                victims = [p for p in res if p not in pinned]
+                if not victims:
+                    break  # current task's own params: allow over-budget
+                victim = min(victims, key=lambda p: lru.get(p, 0))
+                if not fenced and node_id in self.last_output:
+                    jax.block_until_ready(self.last_output[node_id])
+                    fenced = True
+                freed = res.pop(victim)
+                lru.pop(victim, None)
+                self.bytes[node_id] -= _array_bytes(freed)
+                for leaf in jax.tree_util.tree_leaves(freed):
+                    leaf.delete()
+                self.evictions += 1
+            dev = self.cluster[node_id].jax_device
+            # bridge through numpy: on CPU platforms device_put can ALIAS
+            # the host buffer, and evicting an alias would delete the
+            # caller's params out from under them; a numpy view forces the
+            # device copy to own fresh memory, so delete() is always safe
+            import numpy as _np
+
+            host = self.host_params[name]
+            arr = jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(_np.asarray(leaf), dev), host
+            )
+            res[name] = arr
+            # ledger from the PLACED bytes (dtype canonicalization can make
+            # them differ from the host estimate; an asymmetric ledger
+            # would drift and shrink the effective budget)
+            self.bytes[node_id] += _array_bytes(arr)
+            self.peak[node_id] = max(self.peak[node_id], self.bytes[node_id])
+            self.last_use[node_id][name] = self._step
+            self.loads += 1
+            return arr
+
     # -- compilation -------------------------------------------------------
     def _jitted(self, graph: TaskGraph, tid: str):
         """One jitted callable per distinct fn *object*: tasks that share a
@@ -222,6 +323,7 @@ class DeviceBackend:
         graph_input: Any,
         segments: bool = False,
         ext_outputs: Optional[Dict[str, Any]] = None,
+        streamer: Optional["DeviceBackend._ParamStreamer"] = None,
     ) -> float:
         """Compile every (fn, placement-device) combination ahead of time;
         returns seconds.
@@ -238,7 +340,7 @@ class DeviceBackend:
         else:
             self._run(
                 graph, schedule, placed_params, graph_input, profile=False,
-                ext_outputs=ext_outputs,
+                ext_outputs=ext_outputs, streamer=streamer,
             )
         return time.perf_counter() - t0
 
@@ -491,7 +593,8 @@ class DeviceBackend:
         graph_input: Any,
         profile: bool,
         ext_outputs: Optional[Dict[str, Any]] = None,
-    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
+        streamer: Optional["DeviceBackend._ParamStreamer"] = None,
+    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any]]:
         placement = schedule.placement
         # ext_outputs seed the value table: surviving outputs of an earlier
         # (partial) run whose producers are not in this graph — the elastic
@@ -511,15 +614,25 @@ class DeviceBackend:
             task = graph[tid]
             node_id = placement[tid]
             dev = self.cluster[node_id].jax_device
-            pd = {
-                loc: placed_params[(glob, node_id)]
-                for loc, glob in task.param_items()
-            }
 
             arg_ids = task.arg_tasks or task.dependencies
+            if arg_ids and any(d not in outputs for d in arg_ids):
+                continue  # upstream failed; propagate skip (BEFORE any
+                # param loads: a skipped task must not evict live params)
+
+            if streamer is not None:
+                pinned = {glob for _, glob in task.param_items()}
+                pd = {
+                    loc: streamer.get(glob, node_id, pinned)
+                    for loc, glob in task.param_items()
+                }
+            else:
+                pd = {
+                    loc: placed_params[(glob, node_id)]
+                    for loc, glob in task.param_items()
+                }
+
             if arg_ids:
-                if any(d not in outputs for d in arg_ids):
-                    continue  # upstream failed; propagate skip
                 args = []
                 for d in arg_ids:
                     x = outputs[d]
@@ -544,6 +657,8 @@ class DeviceBackend:
             else:
                 out = fn(pd, *args)
             outputs[tid] = out
+            if streamer is not None:
+                streamer.note_output(node_id, out)
 
         # fence ALL dispatched work (not just the topologically-last task:
         # multi-leaf graphs and skipped tails would otherwise under-measure).
@@ -580,6 +695,7 @@ class DeviceBackend:
         segments: bool = False,
         ext_outputs: Optional[Dict[str, Any]] = None,
         keep_outputs: bool = False,
+        stream_params: bool = False,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
@@ -598,6 +714,15 @@ class DeviceBackend:
         fusion keeps segment exports only (internal values never left
         their fused program).  Costs device memory proportional to
         activations held.
+
+        ``stream_params=True`` replaces up-front param placement with
+        on-demand loading + LRU eviction under each node's
+        ``total_memory`` budget (:class:`_ParamStreamer`) — a node whose
+        assigned weights exceed its HBM budget still executes, trading
+        host-link bandwidth for capacity (the reference's param-cache
+        eviction made physical).  Per-task dispatch only (segments fuse
+        the load points away); the report carries
+        ``param_loads``/``param_evictions``/``peak_param_bytes``.
 
         ``profile=True`` records per-task wall times via per-task
         ``block_until_ready`` (Gantt charts / diagnostics).  CAVEAT: on the
@@ -619,6 +744,12 @@ class DeviceBackend:
             raise ValueError(
                 "profile=True needs per-task dispatch; run without segments"
             )
+        if segments and stream_params:
+            raise ValueError(
+                "stream_params needs per-task dispatch (segment fusion "
+                "compiles the per-param load points away); run without "
+                "segments"
+            )
         graph.freeze()
         no_fn = [t.task_id for t in graph if t.fn is None]
         if no_fn:
@@ -629,13 +760,23 @@ class DeviceBackend:
         missing = sorted(graph.unique_params() - set(params))
         if missing:
             raise ValueError(f"params missing for placement: {missing[:5]}")
-        placed, bytes_per_node = self.place_params(graph, schedule, params)
+        if stream_params:
+            placed, bytes_per_node = {}, {d.node_id: 0 for d in self.cluster}
+        else:
+            placed, bytes_per_node = self.place_params(graph, schedule, params)
 
         compile_s = 0.0
         if warmup:
+            # a throwaway streamer for the warmup pass: jit caches warm up,
+            # and the timed run's streamer starts cold (capacity misses are
+            # the thing being measured)
             compile_s = self.warmup(
                 graph, schedule, placed, graph_input, segments=segments,
                 ext_outputs=ext_outputs,
+                streamer=(
+                    self._ParamStreamer(self.cluster, params)
+                    if stream_params else None
+                ),
             )
 
         # fence round-trip, re-measured per execute (outside the timed
@@ -646,6 +787,10 @@ class DeviceBackend:
 
         rtt = _fence_rtt(self._fence_device())
 
+        streamer = (
+            self._ParamStreamer(self.cluster, params)
+            if stream_params else None
+        )
         t0 = time.perf_counter()
         if segments:
             output, timings, tedges, tbytes, n_fences, n_disp, touts = (
@@ -657,7 +802,7 @@ class DeviceBackend:
             output, timings, tedges, tbytes, n_fences, n_disp, touts = (
                 self._run(
                     graph, schedule, placed, graph_input, profile,
-                    ext_outputs,
+                    ext_outputs, streamer,
                 )
             )
         wall = time.perf_counter() - t0
@@ -687,4 +832,7 @@ class DeviceBackend:
             peak_hbm_bytes=peaks,
             n_dispatches=n_disp,
             task_outputs=touts if keep_outputs else {},
+            param_loads=streamer.loads if streamer else 0,
+            param_evictions=streamer.evictions if streamer else 0,
+            peak_param_bytes=dict(streamer.peak) if streamer else {},
         )
