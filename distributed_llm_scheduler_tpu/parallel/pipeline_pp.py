@@ -1,0 +1,153 @@
+"""Whole-program pipeline parallelism: one compiled GPipe scan over a
+``pp`` mesh axis.
+
+The task-graph path already pipelines microbatches ACROSS compiled tasks
+(``sched/pipeline.py`` places contiguous stages, ``sched/eventsim.py``
+orders them 1F1B, the device backend dispatches that order).  This module
+is the same idea expressed the whole-program way: the entire pipeline —
+every stage, every microbatch, every inter-stage hop — is ONE jitted
+program in which stages are mesh shards and activations travel by
+``lax.ppermute`` over ICI, with zero host involvement per hop.
+
+The classic single-scan formulation (cf. the public scaling-book recipe):
+with S stages and M microbatches, step ``t`` of an ``M + S - 1``-step
+``lax.scan`` has stage ``s`` processing microbatch ``t - s`` (when that
+index is live).  Each step every device ppermutes its previous output to
+its successor, selects its input (stage 0: the next embedded microbatch;
+others: the received activation), and runs its block slice.  The fill/
+drain bubbles compute on zero activations — wasted FLOPs by design, the
+textbook pipeline bubble ``(S-1)/(M+S-1)``, masked out of the result.
+
+Layer blocks within a stage run under ``lax.scan`` over stacked params
+(the same scanned-block formulation as ``models/gpt2.forward_scan``), so
+program size is O(1) in depth.  Embedding/head params are replicated
+(only the edge stages read them — the standard GPipe embedding placement
+trade, noted rather than hidden).  The LM head runs once, after the
+scan, on the collected stage-(S-1) activations.
+
+Forward-only: the backward/training pipeline remains the task-graph
+path's job (``frontend/train_dag.py`` + 1F1B ordering).  Parity with the
+plain forward is exact and pinned in ``tests/test_pipeline_pp.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import gpt2
+
+
+def _stack_stage_params(
+    params: Dict[str, jax.Array], config: Any, n_stages: int
+) -> Dict[str, jax.Array]:
+    """Per-layer tensors -> ``(S, L/S, ...)`` stage stacks: the public
+    scanned layout (:func:`..models.gpt2.stack_layer_params`) with its
+    layer axis folded into (stage, layer-in-stage)."""
+    stacked = gpt2.stack_layer_params(params, config)
+    per = config.n_layer // n_stages
+    return {
+        k[len("layers_"):]: v.reshape(n_stages, per, *v.shape[1:])
+        for k, v in stacked.items()
+        if k.startswith("layers_")
+    }
+
+
+def pipeline_forward(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    config: Any,
+    mesh: Mesh,
+    microbatches: int,
+) -> jax.Array:
+    """GPT-2 forward as a pp-sharded pipeline; (B, T) ids -> (B, T, V).
+
+    Requires ``config.n_layer % pp == 0`` and ``B % microbatches == 0``.
+    Matches :func:`..models.gpt2.forward` exactly (same block math, same
+    order) — the pipeline changes WHERE layers run, not what they compute.
+    """
+    S = mesh.shape["pp"]
+    L, B, M = config.n_layer, input_ids.shape[0], microbatches
+    if L % S != 0:
+        raise ValueError(f"n_layer {L} not divisible by pp={S}")
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = B // M
+    T = input_ids.shape[1]
+
+    stage_params = _stack_stage_params(params, config, S)
+    shared = {
+        k: params[k] for k in ("wte", "wpe", "ln_f_g", "ln_f_b")
+    }
+    ids_mb = input_ids.reshape(M, mb, T)
+
+    stage_specs = {k: P("pp") for k in stage_params}
+
+    def shard_fn(stage_p, shared_p, ids_mb):
+        s = lax.axis_index("pp")
+        # (1, L/S, ...) local slice -> (L/S, ...)
+        my_layers = {k: v[0] for k, v in stage_p.items()}
+
+        def run_stage(x):
+            def block_step(h, layer_params):
+                return gpt2.transformer_block(layer_params, h, config), None
+
+            y, _ = lax.scan(block_step, x, my_layers)
+            return y
+
+        perm = [(i, i + 1) for i in range(S - 1)]
+        D = config.n_embd
+
+        def step(carry, t):
+            prev_out, out_buf = carry
+            # successor hop: device s receives s-1's previous output
+            # (device 0 receives zeros — it sources from the embedding)
+            recv = lax.ppermute(prev_out, "pp", perm) if S > 1 else prev_out
+            x0 = gpt2.embedding(
+                ids_mb[jnp.clip(t, 0, M - 1)],
+                shared_p["wte"], shared_p["wpe"],
+            )
+            x = jnp.where(s == 0, x0, recv)
+            y = run_stage(x)
+            widx = t - (S - 1)
+            valid = (widx >= 0) & (widx < M)
+            upd = lax.dynamic_update_index_in_dim(
+                out_buf, y, jnp.clip(widx, 0, M - 1), axis=0
+            )
+            out_buf = jnp.where(valid, upd, out_buf)
+            return (y, out_buf), None
+
+        init = (
+            jnp.zeros((mb, T, D), jnp.float32).astype(config.dtype),
+            jnp.zeros((M, mb, T, D), jnp.float32).astype(config.dtype),
+        )
+        (_, out_buf), _ = lax.scan(
+            step, init, jnp.arange(M + S - 1), length=M + S - 1
+        )
+        # replicate only the (M, mb, T, D) activations — psumming logits
+        # here would move V/D (~65x for real GPT-2) more bytes, and the
+        # head runs ONCE, outside the shard_map, on the gathered result
+        return lax.psum(jnp.where(s == S - 1, out_buf, 0), "pp")
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(stage_specs, {k: P() for k in shared}, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    acts = fn(
+        {
+            k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+            for k, v in stage_params.items()
+        },
+        shared,
+        ids_mb,
+    )
+    x = acts.reshape(B, T, -1)
+    x = gpt2.layer_norm(x, params["ln_f_g"], params["ln_f_b"], config.ln_eps)
+    return gpt2.output_projection(x, params["wte"])

@@ -144,16 +144,19 @@ def test_sim_tracks_real_execution():
             backend.execute(g, s, params, ids, warmup=False).makespan_s
             for _ in range(3)
         )
-        if predicted / measured < 0.6:  # only the direction contention
-            # causes and a re-measure's min() can fix
-            # transient host contention inflates measured makespans (the
-            # CPU mesh shares this machine's cores with everything else);
-            # one re-measure keeps the tolerance meaningful without
-            # failing on a background compile spike
+        tries = 0
+        while predicted / measured < 0.6 and tries < 3:
+            # only the direction contention causes and a re-measure's
+            # min() can fix: transient host contention inflates measured
+            # makespans (the CPU mesh shares this machine's cores with
+            # everything else — observed flaking when a TPU bench ran
+            # concurrently); bounded re-measures keep the tolerance
+            # meaningful without failing on background load spikes
             measured = min(
                 measured,
                 *(backend.execute(g, s, params, ids, warmup=False).makespan_s
                   for _ in range(3)),
             )
+            tries += 1
         ratios[policy] = predicted / measured
     assert all(0.6 <= r <= 1.4 for r in ratios.values()), ratios
