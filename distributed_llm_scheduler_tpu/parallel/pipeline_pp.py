@@ -39,17 +39,45 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models import gpt2
+from ..models import gpt2, llama, mixtral
+
+
+def _family_bits(config: Any):
+    """(module, n_layers, d_model, shared_keys, embed_fn, head_fn) per
+    family — the only family-specific pieces; the pipeline scan itself is
+    identical for every Llama-backbone and GPT-2 model."""
+    name = type(config).__name__.lower()
+    if "gpt2" in name:
+        return (
+            gpt2, config.n_layer, config.n_embd,
+            ("wte", "wpe", "ln_f_g", "ln_f_b"),
+            lambda sp, ids: gpt2.embedding(ids, sp["wte"], sp["wpe"]),
+            lambda p, x: gpt2.output_projection(
+                gpt2.layer_norm(x, p["ln_f_g"], p["ln_f_b"], config.ln_eps),
+                p["wte"],
+            ),
+        )
+    mod = llama if "llama" in name else mixtral
+    return (
+        mod, config.n_layers, config.d_model,
+        ("tok_emb", "final_norm_g", "lm_head"),
+        lambda sp, ids: llama.embedding(ids, sp["tok_emb"]),
+        lambda p, x: llama.lm_head(
+            llama.rms_norm(x, p["final_norm_g"], config.rms_eps),
+            p["lm_head"],
+        ),
+    )
 
 
 def _stack_stage_params(
-    params: Dict[str, jax.Array], config: Any, n_stages: int
+    mod: Any, params: Dict[str, jax.Array], config: Any, n_stages: int,
+    n_layers: int,
 ) -> Dict[str, jax.Array]:
-    """Per-layer tensors -> ``(S, L/S, ...)`` stage stacks: the public
-    scanned layout (:func:`..models.gpt2.stack_layer_params`) with its
-    layer axis folded into (stage, layer-in-stage)."""
-    stacked = gpt2.stack_layer_params(params, config)
-    per = config.n_layer // n_stages
+    """Per-layer tensors -> ``(S, L/S, ...)`` stage stacks: the family's
+    public scanned layout (``stack_layer_params``) with its layer axis
+    folded into (stage, layer-in-stage)."""
+    stacked = mod.stack_layer_params(params, config)
+    per = n_layers // n_stages
     return {
         k[len("layers_"):]: v.reshape(n_stages, per, *v.shape[1:])
         for k, v in stacked.items()
@@ -64,25 +92,24 @@ def pipeline_forward(
     mesh: Mesh,
     microbatches: int,
 ) -> jax.Array:
-    """GPT-2 forward as a pp-sharded pipeline; (B, T) ids -> (B, T, V).
+    """Any family's forward as a pp-sharded pipeline; (B, T) -> (B, T, V).
 
-    Requires ``config.n_layer % pp == 0`` and ``B % microbatches == 0``.
-    Matches :func:`..models.gpt2.forward` exactly (same block math, same
+    Requires ``n_layers % pp == 0`` and ``B % microbatches == 0``.
+    Matches the family's plain ``forward`` exactly (same block math, same
     order) — the pipeline changes WHERE layers run, not what they compute.
     """
+    mod, L, D, shared_keys, embed_fn, head_fn = _family_bits(config)
     S = mesh.shape["pp"]
-    L, B, M = config.n_layer, input_ids.shape[0], microbatches
+    B, M = input_ids.shape[0], microbatches
     if L % S != 0:
-        raise ValueError(f"n_layer {L} not divisible by pp={S}")
+        raise ValueError(f"n_layers {L} not divisible by pp={S}")
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     mb = B // M
     T = input_ids.shape[1]
 
-    stage_params = _stack_stage_params(params, config, S)
-    shared = {
-        k: params[k] for k in ("wte", "wpe", "ln_f_g", "ln_f_b")
-    }
+    stage_params = _stack_stage_params(mod, params, config, S, L)
+    shared = {k: params[k] for k in shared_keys}
     ids_mb = input_ids.reshape(M, mb, T)
 
     stage_specs = {k: P("pp") for k in stage_params}
@@ -94,23 +121,19 @@ def pipeline_forward(
 
         def run_stage(x):
             def block_step(h, layer_params):
-                return gpt2.transformer_block(layer_params, h, config), None
+                return mod.transformer_block(layer_params, h, config), None
 
             y, _ = lax.scan(block_step, x, my_layers)
             return y
 
         perm = [(i, i + 1) for i in range(S - 1)]
-        D = config.n_embd
 
         def step(carry, t):
             prev_out, out_buf = carry
             # successor hop: device s receives s-1's previous output
             # (device 0 receives zeros — it sources from the embedding)
             recv = lax.ppermute(prev_out, "pp", perm) if S > 1 else prev_out
-            x0 = gpt2.embedding(
-                ids_mb[jnp.clip(t, 0, M - 1)],
-                shared_p["wte"], shared_p["wpe"],
-            )
+            x0 = embed_fn(shared_p, ids_mb[jnp.clip(t, 0, M - 1)])
             x = jnp.where(s == 0, x0, recv)
             y = run_stage(x)
             widx = t - (S - 1)
@@ -148,6 +171,4 @@ def pipeline_forward(
         shared,
         ids_mb,
     )
-    x = acts.reshape(B, T, -1)
-    x = gpt2.layer_norm(x, params["ln_f_g"], params["ln_f_b"], config.ln_eps)
-    return gpt2.output_projection(x, params["wte"])
+    return head_fn(params, acts.reshape(B, T, -1))

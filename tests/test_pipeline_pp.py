@@ -69,3 +69,24 @@ def test_pipeline_bf16(setup):
         dtype=np.float32,
     )
     np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral"])
+def test_pipeline_llama_backbone_families(family):
+    """The same pipeline scan serves the Llama backbone (and its MoE
+    variant) — only embed/head/stack plumbing differs per family."""
+    from distributed_llm_scheduler_tpu.models import llama, mixtral
+
+    if family == "llama":
+        mod, config = llama, llama.LlamaConfig.tiny()
+    else:
+        mod, config = mixtral, mixtral.MixtralConfig.tiny()
+    params = mod.init_params(config, jax.random.PRNGKey(2))
+    ids = jax.random.randint(
+        jax.random.PRNGKey(3), (4, 16), 0, config.vocab_size, dtype=jnp.int32
+    )
+    want = np.asarray(mod.forward(params, ids, config))
+    got = np.asarray(
+        pipeline_forward(params, ids, config, _mesh(2), microbatches=2)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
