@@ -46,8 +46,10 @@ def _family_bits(config: Any):
     """(module, n_layers, d_model, shared_keys, embed_fn, head_fn) per
     family — the only family-specific pieces; the pipeline scan itself is
     identical for every Llama-backbone and GPT-2 model."""
-    name = type(config).__name__.lower()
-    if "gpt2" in name:
+    from .decode import _family_of  # the ONE validated family dispatch
+
+    family = _family_of(config)  # raises ValueError for unknown configs
+    if family == "gpt2":
         return (
             gpt2, config.n_layer, config.n_embd,
             ("wte", "wpe", "ln_f_g", "ln_f_b"),
@@ -57,7 +59,7 @@ def _family_bits(config: Any):
                 p["wte"],
             ),
         )
-    mod = llama if "llama" in name else mixtral
+    mod = llama if family == "llama" else mixtral
     return (
         mod, config.n_layers, config.d_model,
         ("tok_emb", "final_norm_g", "lm_head"),
