@@ -25,9 +25,13 @@ program size is O(1) in depth.  Embedding/head params are replicated
 trade, noted rather than hidden).  The LM head runs once, after the
 scan, on the collected stage-(S-1) activations.
 
-Forward-only: the backward/training pipeline remains the task-graph
-path's job (``frontend/train_dag.py`` + 1F1B ordering).  Parity with the
-plain forward is exact and pinned in ``tests/test_pipeline_pp.py``.
+The pipeline DIFFERENTIATES: reverse-mode AD through the ppermute scan is
+the backward pipeline (ppermute transposes to the reverse hop; the scan
+transposes to the reverse schedule), so :func:`pp_loss_fn` +
+``jax.value_and_grad`` is pipeline-parallel training with no extra code —
+gradients match the plain forward's to float precision
+(``tests/test_pipeline_pp.py``).  :func:`make_pp_train_step` packages it
+with an optimizer the same way ``parallel/train.py`` does for dp/tp.
 """
 
 from __future__ import annotations
@@ -174,3 +178,46 @@ def pipeline_forward(
         ids_mb,
     )
     return head_fn(params, acts.reshape(B, T, -1))
+
+
+def pp_loss_fn(
+    params: Dict[str, jax.Array],
+    input_ids: jax.Array,
+    targets: jax.Array,
+    config: Any,
+    mesh: Mesh,
+    microbatches: int,
+) -> jax.Array:
+    """Next-token cross-entropy through the pipelined forward.
+
+    Differentiable end-to-end: ``jax.grad`` of this IS pipeline-parallel
+    backprop (the scan/ppermute transpose is the backward pipeline).
+    """
+    logits = pipeline_forward(params, input_ids, config, mesh, microbatches)
+    # the one shared next-token cross-entropy (models/mixtral.nll_loss —
+    # also used by the EP path), not a fifth copy of the same math
+    return mixtral.nll_loss(logits, targets)
+
+
+def make_pp_train_step(
+    config: Any,
+    mesh: Mesh,
+    microbatches: int,
+    optimizer: Any = None,
+):
+    """``(train_step, init_state)`` for pipeline-parallel training, the
+    same contract as :func:`.train.make_train_step` (jitted step with
+    donated state; params flat — the pipeline stacks them per call, so
+    checkpoints stay in the shared flat layout)."""
+    from .train import make_step_from_loss
+
+    mod, *_ = _family_bits(config)
+
+    def loss(params, input_ids, targets):
+        return pp_loss_fn(
+            params, input_ids, targets, config, mesh, microbatches
+        )
+
+    return make_step_from_loss(
+        loss, lambda key: mod.init_params(config, key), optimizer
+    )

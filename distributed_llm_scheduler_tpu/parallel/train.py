@@ -31,6 +31,41 @@ class TrainState:
     step: Any
 
 
+def make_step_from_loss(
+    loss_fn: Callable[..., Any],
+    init_params: Callable[[Any], Dict[str, Any]],
+    optimizer: Optional[optax.GradientTransformation] = None,
+) -> Tuple[Callable[..., Any], Callable[..., "TrainState"]]:
+    """The optimizer skeleton shared by train-step builders:
+    ``loss_fn(params, input_ids, targets)`` + a param initializer ->
+    ``(jitted donated-state step, init_state)``.  :func:`make_train_step`
+    layers mesh sharding on top; the pipeline path
+    (``parallel/pipeline_pp.make_pp_train_step``) uses it directly."""
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_state(key: Optional[jax.Array] = None) -> TrainState:
+        key = key if key is not None else jax.random.PRNGKey(0)
+        params = init_params(key)
+        return TrainState(
+            params=params, opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def step_fn(state: TrainState, input_ids, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, input_ids, targets
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(
+            params=params, opt_state=opt_state, step=state.step + 1
+        ), loss
+
+    return jax.jit(step_fn, donate_argnums=(0,)), init_state
+
+
 def make_train_step(
     config: GPT2Config,
     mesh: Mesh,

@@ -90,3 +90,43 @@ def test_pipeline_llama_backbone_families(family):
         pipeline_forward(params, ids, config, _mesh(2), microbatches=2)
     )
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_backward_matches_plain_grads(setup):
+    """Reverse-mode AD through the ppermute scan IS the backward pipeline:
+    gradients equal the plain forward's to float precision."""
+    from distributed_llm_scheduler_tpu.parallel.pipeline_pp import pp_loss_fn
+
+    config, params, ids = setup
+    targets = jnp.roll(ids, -1, axis=1)
+    lp, gp = jax.value_and_grad(
+        lambda p: pp_loss_fn(p, ids, targets, config, _mesh(2), 2)
+    )(params)
+    # reference: the model's own loss_fn, not a local copy of its math
+    ll, gl = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, ids, targets, config)
+    )(params)
+    assert np.allclose(float(lp), float(ll), rtol=1e-6)
+    for k in gl:
+        np.testing.assert_allclose(
+            np.asarray(gp[k]), np.asarray(gl[k]), rtol=1e-4, atol=1e-5,
+            err_msg=k,
+        )
+
+
+def test_pp_train_step_decreases_loss(setup):
+    from distributed_llm_scheduler_tpu.parallel.pipeline_pp import (
+        make_pp_train_step,
+    )
+
+    config, _, ids = setup
+    targets = jnp.roll(ids, -1, axis=1)
+    train_step, init_state = make_pp_train_step(
+        config, _mesh(2), microbatches=2
+    )
+    state = init_state(jax.random.PRNGKey(0))
+    state, l0 = train_step(state, ids, targets)
+    for _ in range(4):
+        state, l1 = train_step(state, ids, targets)
+    assert float(l1) < float(l0)
+    assert int(state.step) == 5
