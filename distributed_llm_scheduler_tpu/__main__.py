@@ -396,11 +396,43 @@ def cmd_train(args) -> int:
               "path: --train-step on schedule/execute)", file=sys.stderr)
         return 2
     mcfg = cfg_map[args.model]()
-    axes = factorize_mesh(len(jax.devices()))
-    mesh = make_mesh(**axes)
-    train_step, init_state = make_train_step(
-        mcfg, mesh, remat=args.remat, scan=args.scan
-    )
+    pp_mb = 0
+    if args.pp:
+        # pipeline-parallel training: stages as mesh shards, one GPipe
+        # scan per step (parallel/pipeline_pp.py)
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from .parallel.pipeline_pp import make_pp_train_step
+
+        if args.remat or args.scan:
+            print("--pp composes with neither --remat nor --scan yet",
+                  file=sys.stderr)
+            return 2
+        layers = mcfg.n_layer
+        if (
+            args.pp < 1
+            or layers % args.pp
+            or args.pp > len(jax.devices())
+        ):
+            print(f"--pp {args.pp} must be >= 1, divide n_layer={layers}, "
+                  f"and not exceed {len(jax.devices())} devices",
+                  file=sys.stderr)
+            return 2
+        mesh = Mesh(np.array(jax.devices()[:args.pp]), ("pp",))
+        axes = {"dp": 1, "tp": 1, "sp": 1}
+        # ONE effective microbatch count, baked into the compiled step AND
+        # used for batch sizing below
+        pp_mb = max(args.microbatches, args.pp)
+        train_step, init_state = make_pp_train_step(
+            mcfg, mesh, microbatches=pp_mb
+        )
+    else:
+        axes = factorize_mesh(len(jax.devices()))
+        mesh = make_mesh(**axes)
+        train_step, init_state = make_train_step(
+            mcfg, mesh, remat=args.remat, scan=args.scan
+        )
     state = init_state(jax.random.PRNGKey(args.seed))
     if args.ckpt and os.path.exists(args.ckpt):
         from .utils.checkpoint import load_state
@@ -409,6 +441,8 @@ def cmd_train(args) -> int:
         print(f"resumed from {args.ckpt} at step {int(state.step)}",
               file=sys.stderr)
     batch = max(2 * axes["dp"], 2)
+    if pp_mb:
+        batch = max(batch, pp_mb)  # each microbatch needs >= 1 sequence
     seq = min(args.seq_len, mcfg.n_positions)
     ids = jax.random.randint(
         jax.random.PRNGKey(1), (batch, seq), 0, mcfg.vocab_size, dtype=jnp.int32
@@ -564,6 +598,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("train", help="run sharded training steps")
     _add_common(p)
     p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--pp", type=int, default=0,
+                   help="N>0: pipeline-parallel training over N stage "
+                        "devices (GPipe scan; microbatches default to N)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer blocks in the backward "
                         "pass (jax.checkpoint): HBM for FLOPs")

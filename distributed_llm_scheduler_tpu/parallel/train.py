@@ -35,12 +35,15 @@ def make_step_from_loss(
     loss_fn: Callable[..., Any],
     init_params: Callable[[Any], Dict[str, Any]],
     optimizer: Optional[optax.GradientTransformation] = None,
+    data_sharding: Optional[Any] = None,
 ) -> Tuple[Callable[..., Any], Callable[..., "TrainState"]]:
-    """The optimizer skeleton shared by train-step builders:
+    """The ONE optimizer skeleton behind every train-step builder:
     ``loss_fn(params, input_ids, targets)`` + a param initializer ->
-    ``(jitted donated-state step, init_state)``.  :func:`make_train_step`
-    layers mesh sharding on top; the pipeline path
-    (``parallel/pipeline_pp.make_pp_train_step``) uses it directly."""
+    ``(jitted donated-state step, init_state)``.  ``data_sharding``
+    (a NamedSharding) pins the token batches onto the mesh and becomes
+    the jit input sharding — :func:`make_train_step` supplies it for the
+    dp/sp path; the pipeline path (``pipeline_pp.make_pp_train_step``)
+    runs without it (tokens replicated, stages sharded inside)."""
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
 
     def init_state(key: Optional[jax.Array] = None) -> TrainState:
@@ -63,7 +66,20 @@ def make_step_from_loss(
             params=params, opt_state=opt_state, step=state.step + 1
         ), loss
 
-    return jax.jit(step_fn, donate_argnums=(0,)), init_state
+    if data_sharding is None:
+        return jax.jit(step_fn, donate_argnums=(0,)), init_state
+
+    jitted = jax.jit(
+        step_fn, in_shardings=(None, data_sharding, data_sharding),
+        donate_argnums=(0,),
+    )
+
+    def train_step(state: TrainState, input_ids, targets):
+        input_ids = jax.device_put(input_ids, data_sharding)
+        targets = jax.device_put(targets, data_sharding)
+        return jitted(state, input_ids, targets)
+
+    return train_step, init_state
 
 
 def make_train_step(
@@ -92,35 +108,16 @@ def make_train_step(
             params, input_ids, targets, config, remat=remat, scan=scan
         )
 
-    def init_state(key: Optional[jax.Array] = None) -> TrainState:
-        key = key if key is not None else jax.random.PRNGKey(0)
+    def init_params(key: jax.Array) -> Dict[str, Any]:
         params = gpt2.init_params(config, key)
         if scan:
             params = gpt2.stack_layer_params(params, config)
-        params = shard_params(mesh, params)
-        opt_state = optimizer.init(params)
-        return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+        return shard_params(mesh, params)
 
-    data_sh = batch_sharding(mesh, seq_parallel=seq_parallel)
-
-    def step_fn(state: TrainState, input_ids, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, input_ids, targets
-        )
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
-
-    jitted = jax.jit(step_fn, in_shardings=(None, data_sh, data_sh), donate_argnums=(0,))
-
-    def train_step(state: TrainState, input_ids, targets):
-        input_ids = jax.device_put(input_ids, data_sh)
-        targets = jax.device_put(targets, data_sh)
-        return jitted(state, input_ids, targets)
-
-    return train_step, init_state
+    return make_step_from_loss(
+        loss_fn, init_params, optimizer,
+        data_sharding=batch_sharding(mesh, seq_parallel=seq_parallel),
+    )
 
 
 def make_eval_step(config: GPT2Config, mesh: Mesh, seq_parallel: bool = False):

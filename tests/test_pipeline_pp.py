@@ -130,3 +130,33 @@ def test_pp_train_step_decreases_loss(setup):
         state, l1 = train_step(state, ids, targets)
     assert float(l1) < float(l0)
     assert int(state.step) == 5
+
+
+def test_train_cli_pp():
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        DLS_PLATFORM="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_scheduler_tpu", "train",
+         "--model", "gpt2-tiny", "--pp", "2", "--steps", "2",
+         "--seq-len", "16"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "step 2: loss" in r.stdout
+    # non-dividing stage count refuses cleanly
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_scheduler_tpu", "train",
+         "--model", "gpt2-tiny", "--pp", "3", "--steps", "1",
+         "--seq-len", "16"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300,
+    )
+    assert r.returncode == 2
+    assert "divide" in r.stderr
