@@ -405,9 +405,11 @@ def cmd_train(args) -> int:
 
         from .parallel.pipeline_pp import make_pp_train_step
 
-        if args.remat or args.scan:
-            print("--pp composes with neither --remat nor --scan yet",
-                  file=sys.stderr)
+        if args.scan:
+            # stages already lax.scan their layer blocks; a separate
+            # --scan would be a no-op claim
+            print("--pp already scans layer blocks within each stage; "
+                  "drop --scan", file=sys.stderr)
             return 2
         layers = mcfg.n_layer
         if (
@@ -425,7 +427,7 @@ def cmd_train(args) -> int:
         # used for batch sizing below
         pp_mb = max(args.microbatches, args.pp)
         train_step, init_state = make_pp_train_step(
-            mcfg, mesh, microbatches=pp_mb
+            mcfg, mesh, microbatches=pp_mb, remat=args.remat
         )
     else:
         axes = factorize_mesh(len(jax.devices()))

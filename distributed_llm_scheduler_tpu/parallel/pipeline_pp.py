@@ -97,12 +97,16 @@ def pipeline_forward(
     config: Any,
     mesh: Mesh,
     microbatches: int,
+    remat: bool = False,
 ) -> jax.Array:
     """Any family's forward as a pp-sharded pipeline; (B, T) -> (B, T, V).
 
     Requires ``n_layers % pp == 0`` and ``B % microbatches == 0``.
     Matches the family's plain ``forward`` exactly (same block math, same
     order) — the pipeline changes WHERE layers run, not what they compute.
+    ``remat=True`` checkpoints each layer block, so the backward pipeline
+    recomputes block activations instead of storing every step's — the
+    same HBM-for-FLOPs trade as the dp/tp path's ``remat``.
     """
     mod, L, D, shared_keys, embed_fn, head_fn = _family_bits(config)
     S = mesh.shape["pp"]
@@ -125,9 +129,14 @@ def pipeline_forward(
         # (1, L/S, ...) local slice -> (L/S, ...)
         my_layers = {k: v[0] for k, v in stage_p.items()}
 
+        block_fn = (
+            jax.checkpoint(mod.transformer_block, static_argnums=(2,))
+            if remat else mod.transformer_block
+        )
+
         def run_stage(x):
             def block_step(h, layer_params):
-                return mod.transformer_block(layer_params, h, config), None
+                return block_fn(layer_params, h, config), None
 
             y, _ = lax.scan(block_step, x, my_layers)
             return y
@@ -187,13 +196,16 @@ def pp_loss_fn(
     config: Any,
     mesh: Mesh,
     microbatches: int,
+    remat: bool = False,
 ) -> jax.Array:
     """Next-token cross-entropy through the pipelined forward.
 
     Differentiable end-to-end: ``jax.grad`` of this IS pipeline-parallel
     backprop (the scan/ppermute transpose is the backward pipeline).
     """
-    logits = pipeline_forward(params, input_ids, config, mesh, microbatches)
+    logits = pipeline_forward(
+        params, input_ids, config, mesh, microbatches, remat=remat
+    )
     # the one shared next-token cross-entropy (models/mixtral.nll_loss —
     # also used by the EP path), not a fifth copy of the same math
     return mixtral.nll_loss(logits, targets)
@@ -204,6 +216,7 @@ def make_pp_train_step(
     mesh: Mesh,
     microbatches: int,
     optimizer: Any = None,
+    remat: bool = False,
 ):
     """``(train_step, init_state)`` for pipeline-parallel training, the
     same contract as :func:`.train.make_train_step` (jitted step with
@@ -215,7 +228,8 @@ def make_pp_train_step(
 
     def loss(params, input_ids, targets):
         return pp_loss_fn(
-            params, input_ids, targets, config, mesh, microbatches
+            params, input_ids, targets, config, mesh, microbatches,
+            remat=remat,
         )
 
     return make_step_from_loss(
