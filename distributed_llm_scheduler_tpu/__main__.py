@@ -283,7 +283,10 @@ def _parse_injection(spec: str, cluster):
         print(f"--inject-failure: fraction {frac} outside [0, 1]",
               file=sys.stderr)
         return None
-    if node.isdigit():
+    # literal node id first: a cluster whose ids are themselves numeric
+    # strings must stay addressable by id (the index reading would shadow
+    # it and could resolve to a different device)
+    if node not in cluster and node.isdigit():
         idx = int(node)
         if idx >= len(cluster):
             print(f"--inject-failure: node index {idx} out of range "

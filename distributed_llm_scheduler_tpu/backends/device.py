@@ -69,7 +69,11 @@ class DeviceReport:
     # recovery (every executed task per-task; segment exports under
     # segment fusion).  Keys feed reschedule()/execute(ext_outputs=...)
     task_outputs: Dict[str, Any] = field(default_factory=dict)
-    # execute(stream_params=True): streaming statistics
+    # execute(stream_params=True): streaming statistics.  ``streamed`` is
+    # the explicit mode flag — a streamed run that happened to load zero
+    # params still reports its (all-zero) stats, so the mode is always
+    # distinguishable in the JSON
+    streamed: bool = False
     param_loads: int = 0
     param_evictions: int = 0
     peak_param_bytes: Dict[str, int] = field(default_factory=dict)
@@ -100,7 +104,7 @@ class DeviceReport:
                         for k, v in self.peak_param_bytes.items()
                     },
                 }
-                if self.param_loads
+                if self.streamed
                 else {}
             ),
         }
@@ -832,6 +836,7 @@ class DeviceBackend:
             peak_hbm_bytes=peaks,
             n_dispatches=n_disp,
             task_outputs=touts if keep_outputs else {},
+            streamed=streamer is not None,
             param_loads=streamer.loads if streamer else 0,
             param_evictions=streamer.evictions if streamer else 0,
             peak_param_bytes=dict(streamer.peak) if streamer else {},

@@ -1,7 +1,8 @@
 // Native list-scheduling engine.
 //
-// Implements the memory-constrained list-scheduling state machine and all six
-// placement policies (roundrobin / dfs / greedy / critical / mru / heft) over
+// Implements the memory-constrained list-scheduling state machine and all
+// eight placement policies (roundrobin / dfs / greedy / critical / mru /
+// heft / pipeline / pack — see POLICY_IDS in __init__.py) over
 // a flattened, integer-indexed task graph.  Semantics are an exact mirror of
 // the Python policies in ../sched/{base,policies,heft}.py — which themselves
 // mirror the reference's observed behavior (reference schedulers.py:31-525) —

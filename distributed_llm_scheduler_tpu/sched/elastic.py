@@ -128,7 +128,7 @@ def reschedule(
     cluster: Cluster,
     scheduler,
     have_outputs: Optional[Iterable[str]] = None,
-) -> Tuple[Schedule, Set[str], Set[str]]:
+) -> Tuple[Schedule, TaskGraph, Set[str], Set[str]]:
     """Re-place everything that must (re-)run after ``dead_nodes`` fail.
 
     Args:

@@ -129,17 +129,40 @@ def rederive_shard_quants(params: Dict[str, Any]) -> Dict[str, Any]:
         bq = out.get(base)
         if not isinstance(bq, QParam):
             continue
-        off = 0
         base_shape = bq.q.shape
 
         def _shape_of(v):
             return tuple((v.q if isinstance(v, QParam) else v).shape)
 
-        for _, name in entries:
-            if name not in out:
-                continue
-            shape = _shape_of(out[name])
-            if shape[1:] == base_shape[1:]:  # row slice (tok_emb/wte)
+        present = [name for _, name in entries if name in out]
+        shapes = [_shape_of(out[name]) for name in present]
+        if not shapes:
+            continue
+        # Infer the slicing axis ONCE per group from all shard shapes —
+        # per-shard shape matching with rows-tried-first silently
+        # misreads a square table (or any layout satisfying both tests)
+        # as row slices with the wrong scale columns (ADVICE r2).
+        rows_ok = all(s[1:] == base_shape[1:] for s in shapes)
+        cols_ok = all(s[:-1] == base_shape[:-1] for s in shapes)
+        if rows_ok and cols_ok:
+            # ambiguous (square base): the shard extents must tile
+            # exactly one of the axes; a single whole-table "shard" is
+            # identical under either reading
+            if shapes == [base_shape]:
+                cols_ok = False
+            else:
+                rsum = sum(s[0] for s in shapes)
+                csum = sum(s[-1] for s in shapes)
+                rows_ok = rsum == base_shape[0] and csum != base_shape[-1]
+                cols_ok = (not rows_ok) and csum == base_shape[-1]
+        if rows_ok == cols_ok:
+            raise ValueError(
+                f"shard group {base!r}: cannot disambiguate row vs column "
+                f"slicing (base {base_shape}, shards {shapes})"
+            )
+        off = 0
+        for name, shape in zip(present, shapes):
+            if rows_ok:  # row slice (tok_emb/wte)
                 if isinstance(out[name], QParam):
                     out[name] = QParam(
                         q=bq.q[off:off + shape[0]], scale=bq.scale
@@ -147,7 +170,7 @@ def rederive_shard_quants(params: Dict[str, Any]) -> Dict[str, Any]:
                 # advance even for fp shards: offsets are positional,
                 # not conditional on quantization
                 off += shape[0]
-            elif shape[:-1] == base_shape[:-1]:  # column slice (lm_head)
+            else:  # column slice (lm_head)
                 if isinstance(out[name], QParam):
                     out[name] = QParam(
                         q=bq.q[..., off:off + shape[-1]],
