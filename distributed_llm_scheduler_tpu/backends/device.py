@@ -153,27 +153,28 @@ class DeviceBackend:
         """The device the end-of-run fence reads back from."""
         return self.cluster.devices[0].jax_device
 
-    def _fence_run(
-        self, outputs: Dict[str, Any], last_on_device: Dict[str, Any]
-    ) -> int:
+    def _fence_run(self, last_on_device: Dict[str, Any]) -> int:
         """Fence ALL dispatched work with ONE readback; returns the fence
         count (1) to subtract as RTT.
 
-        ``block_until_ready`` first, then a combined readback fence:
-        block_until_ready is unreliable through the axon tunnel (it can
-        return before compute completes — utils/costmodel.readback_fence),
-        and per-device queues are FIFO, so one fenced value per device
-        proves that device's whole queue drained.  One element of each
-        device's last output is pulled onto the fence device and their
-        (dependent) combination read back — one RTT regardless of device
-        count; per-device sequential fences would over-subtract when an
+        One element of each device's last output is pulled onto the fence
+        device and their (dependent) combination read back: the bytes
+        cannot exist on the host before every contributing device's queue
+        drained (per-device queues are FIFO), so the single readback
+        proves completion everywhere — one RTT regardless of device
+        count.  Per-device sequential fences would over-subtract when an
         early fence's round-trip overlaps a straggler device's remaining
-        compute.  Shared by the per-task and segment-fused paths so their
-        makespan measurements cannot drift.
+        compute.  Deliberately NO ``block_until_ready`` on the outputs
+        first: through the axon tunnel that call costs a full extra
+        round-trip (~70-80 ms on a bad reconnect) that the single-RTT
+        correction would not net out — exactly the bias that made round
+        2's segmented makespan read 82.6 ms for a ~10 ms program (and it
+        adds nothing: the dependent readback already implies completion).
+        Shared by the per-task and segment-fused paths so their makespan
+        measurements cannot drift.
         """
         from ..utils.costmodel import readback_fence
 
-        jax.block_until_ready(list(outputs.values()))
         fence_dev = self._fence_device()
         tips = []
         for out in last_on_device.values():
@@ -516,6 +517,7 @@ class DeviceBackend:
         placed_params: Dict[Tuple[str, str], Any],
         graph_input: Any,
         ext_outputs: Optional[Dict[str, Any]] = None,
+        fence: bool = True,
     ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
         """Segment-fused execution: same placement, one launch per segment.
         Tasks with failed upstreams are dropped at segment-build time (host
@@ -574,8 +576,8 @@ class DeviceBackend:
                 last_on_device[node] = outputs[exports[-1]]
         # guard on executed segments, not `outputs` — ext_outputs seeds can
         # make `outputs` non-empty when nothing actually ran
-        if last_on_device:
-            n_fences = self._fence_run(outputs, last_on_device)
+        if last_on_device and fence:
+            n_fences = self._fence_run(last_on_device)
         # same semantics as the per-task path: None when the graph's last
         # task didn't execute (callers detect incomplete runs by this)
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
@@ -598,6 +600,7 @@ class DeviceBackend:
         profile: bool,
         ext_outputs: Optional[Dict[str, Any]] = None,
         streamer: Optional["DeviceBackend._ParamStreamer"] = None,
+        fence: bool = True,
     ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any]]:
         placement = schedule.placement
         # ext_outputs seed the value table: surviving outputs of an earlier
@@ -672,12 +675,12 @@ class DeviceBackend:
         # and per-device queues are FIFO so one fenced value per device
         # proves that device's whole queue drained.
         n_fences = 0
-        if len(outputs) > n_ext:
+        if len(outputs) > n_ext and fence:
             last_on_device: Dict[str, Any] = {}
             for tid in order:
                 if tid in outputs:
                     last_on_device[placement[tid]] = outputs[tid]
-            n_fences = self._fence_run(outputs, last_on_device)
+            n_fences = self._fence_run(last_on_device)
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
         executed = {
             k: v for k, v in outputs.items()
@@ -700,8 +703,21 @@ class DeviceBackend:
         ext_outputs: Optional[Dict[str, Any]] = None,
         keep_outputs: bool = False,
         stream_params: bool = False,
+        reps: int = 1,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
+
+        ``reps > 1`` dispatches the whole placed run ``reps`` times
+        back-to-back and fences ONCE at the end; ``makespan_s`` is then
+        the per-run amortized wall ``(total - fence_rtt) / reps``.  This
+        is the trustworthy timing mode on tunneled devices, where the
+        fence round-trip (tens of ms on a bad reconnect, jittering by
+        several ms between draws) would otherwise be the same order as
+        the thing measured: one fence amortized over a long window makes
+        the RTT correction's residual error negligible.  Incompatible
+        with ``profile`` (per-task fences) and ``stream_params`` (later
+        reps would measure a warm param cache, not the cold streaming
+        behavior under test).
 
         ``ext_outputs`` seeds task outputs produced OUTSIDE this graph —
         the elastic-recovery path (``sched/elastic.py``): a remainder
@@ -754,6 +770,12 @@ class DeviceBackend:
                 "compiles the per-param load points away); run without "
                 "segments"
             )
+        if reps > 1 and (profile or stream_params):
+            raise ValueError(
+                "reps > 1 amortizes over identical repeated runs; profile "
+                "mode fences per task and stream_params runs must start "
+                "cold — measure those with reps=1"
+            )
         graph.freeze()
         no_fn = [t.task_id for t in graph if t.fn is None]
         if no_fn:
@@ -796,21 +818,24 @@ class DeviceBackend:
             if stream_params else None
         )
         t0 = time.perf_counter()
-        if segments:
-            output, timings, tedges, tbytes, n_fences, n_disp, touts = (
-                self._run_segmented(
-                    graph, schedule, placed, graph_input, ext_outputs
+        for r in range(reps):
+            fence = r == reps - 1  # intermediate reps queue without fencing
+            if segments:
+                output, timings, tedges, tbytes, n_fences, n_disp, touts = (
+                    self._run_segmented(
+                        graph, schedule, placed, graph_input, ext_outputs,
+                        fence=fence,
+                    )
                 )
-            )
-        else:
-            output, timings, tedges, tbytes, n_fences, n_disp, touts = (
-                self._run(
-                    graph, schedule, placed, graph_input, profile,
-                    ext_outputs, streamer,
+            else:
+                output, timings, tedges, tbytes, n_fences, n_disp, touts = (
+                    self._run(
+                        graph, schedule, placed, graph_input, profile,
+                        ext_outputs, streamer, fence=fence,
+                    )
                 )
-            )
         wall = time.perf_counter() - t0
-        makespan = max(wall - n_fences * rtt, 1e-9)
+        makespan = max((wall - n_fences * rtt) / reps, 1e-9)
 
         peaks: Dict[str, int] = {}
         for d in self.cluster:

@@ -185,7 +185,8 @@ class SimulatedBackend:
 
     def __init__(self, fidelity: str = "full", link: Optional[LinkModel] = None,
                  prefetch_params: bool = True, host_slots: Optional[int] = None,
-                 dispatch_s: float = 0.0):
+                 dispatch_s: float = 0.0,
+                 host_synchronous_transfers: bool = False):
         if fidelity not in ("full", "reference"):
             raise ValueError(f"fidelity must be 'full' or 'reference', got {fidelity!r}")
         if host_slots is not None and host_slots < 1:
@@ -204,6 +205,19 @@ class SimulatedBackend:
         # requires capping concurrency at the physical core count — this is
         # what makes sim-vs-real validation honest on any machine.
         self.host_slots = host_slots
+        # Host-mediated transfers: in the real per-task dispatch loop every
+        # cross-node edge is an inline ``jax.device_put`` — a HOST call.
+        # On platforms where that call blocks while copying (the CPU mesh:
+        # device_put is a synchronous memcpy), each transfer's full wire
+        # time also occupies the serial dispatcher, delaying every later
+        # dispatch.  Without this, a transfer-heavy placement's replay
+        # ties a transfer-light one while its measured makespan is ~1.5x
+        # worse (found by eval/rankcheck on the flagship structure).  On
+        # real TPU (async DMA) leave False; the per-call host cost is
+        # covered by dispatch_s below.
+        self.host_synchronous_transfers = (
+            host_synchronous_transfers and fidelity == "full"
+        )
         if fidelity == "reference":
             # Reference fidelity is *defined* as zero-cost data movement
             # (paper §6.6.1); a caller-supplied link would silently skew
@@ -297,7 +311,20 @@ class SimulatedBackend:
                         )
                         dep_ready += xfer
                         transfer_total += xfer
+                        if self.host_synchronous_transfers:
+                            # a cross-node device_put needs CONCRETE
+                            # bytes: the dispatcher blocks until the
+                            # producer finishes, then performs the copy
+                            # itself — so every cross-node edge collapses
+                            # the dispatch-ahead window to the producer's
+                            # finish time before charging the copy
+                            host_clock = max(host_clock, finish[d]) + xfer
                     start = max(start, dep_ready)
+                if self.host_synchronous_transfers:
+                    # the task cannot start before the dispatcher finished
+                    # copying ALL its inputs (start was read from
+                    # host_clock before the dep loop advanced it)
+                    start = max(start, host_clock)
                 if self.prefetch_params:
                     # DMA overlaps compute; task just waits for its weights
                     start = max(start, params_ready)

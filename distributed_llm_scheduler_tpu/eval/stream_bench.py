@@ -1,0 +1,119 @@
+"""Oversubscribed execution probe: a model bigger than the device budget.
+
+The reference's headline scenario is scheduling a 37.5 GB-param model onto
+28 GB of laptops (reference ``test_gpt2.py:274-299``) with parameter
+eviction (reference ``schedulers.py:404-442``) — but it only ever
+*simulates* that.  This probe makes it physical on a real chip (VERDICT r2
+next #3): cap the node's parameter budget at a fraction of the model's
+total param bytes and execute with ``stream_params=True`` — params load on
+first use and the LRU streamer evicts residents to stay under budget, so
+the model runs correctly even though its weights never co-reside.
+
+Run directly (on the TPU, or the CPU mesh for a functional check)::
+
+    python -m distributed_llm_scheduler_tpu.eval.stream_bench [budget_frac]
+
+Emits one JSON dict: uncapped (all params resident) vs capped+streamed
+makespans, load/eviction counts, peak resident param bytes (must respect
+the budget), and an output-parity flag against the fused forward.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def measure_streaming(
+    config: Any = None,
+    batch: int = 8,
+    seq_len: int = 512,
+    budget_frac: float = 0.3,
+    policy: str = "greedy",
+    log=lambda m: print(m, file=sys.stderr, flush=True),
+) -> Dict[str, Any]:
+    """Execute a forward DAG per-task with params capped at
+    ``budget_frac`` x total param bytes, vs. the uncapped placed run.
+
+    Single-device by design: the point is the *capacity* mechanism, so
+    one node holds the whole model (uncapped) or streams it (capped) —
+    the purest form of the reference's oversubscription scenario.
+    """
+    from .. import get_scheduler
+    from ..backends.device import DeviceBackend
+    from ..core.cluster import Cluster
+    from ..frontend.gpt2_dag import build_gpt2_dag
+    from ..models.gpt2 import GPT2Config
+
+    if config is None:
+        config = GPT2Config.medium(dtype=jnp.bfloat16)
+    dag = build_gpt2_dag(config, batch=batch, seq_len=seq_len)
+    graph = dag.graph
+    params = dag.init_params()
+    ids = dag.make_inputs()
+    total_param_gb = graph.total_param_gb()
+
+    dev = jax.devices()[0]
+    cluster = Cluster.from_jax_devices([dev])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler(policy).schedule(graph, cluster)
+    assert not sched.failed, "single uncapped node must fit every task"
+
+    # uncapped: params placed up-front, all resident
+    from .benchlib import oracle_close
+
+    dtype_name = jnp.dtype(config.dtype).name
+    rep_full = backend.execute(graph, sched, params, ids)
+    fused = dag.reference_forward(params, ids)
+    full_ok = oracle_close(fused, rep_full.output, dtype_name)
+    log(f"stream_bench: uncapped makespan {rep_full.makespan_s*1e3:.1f} ms "
+        f"({total_param_gb:.3f} GB params resident); oracle: {full_ok}")
+
+    # capped: budget below total params -> must stream + evict.
+    # budget is set AFTER scheduling so the placement is identical — the
+    # comparison isolates the capacity mechanism, not policy reaction.
+    budget_gb = total_param_gb * budget_frac
+    for d in cluster:
+        d.total_memory = budget_gb
+    rep_cap = backend.execute(graph, sched, params, ids, stream_params=True)
+    cap_ok = oracle_close(fused, rep_cap.output, dtype_name)
+    peak_gb = max(rep_cap.peak_param_bytes.values()) / 1024**3
+    log(f"stream_bench: capped@{budget_frac:.2f}x makespan "
+        f"{rep_cap.makespan_s*1e3:.1f} ms; {rep_cap.param_loads} loads, "
+        f"{rep_cap.param_evictions} evictions, peak resident "
+        f"{peak_gb:.3f} GB on {budget_gb:.3f} GB budget; oracle: {cap_ok}")
+
+    n_params = len(graph.unique_params())
+    return {
+        "model": graph.name,
+        "platform": dev.platform,
+        "n_tasks": len(graph),
+        "n_params": n_params,
+        "total_param_gb": round(total_param_gb, 4),
+        "budget_frac": budget_frac,
+        "budget_gb": round(budget_gb, 4),
+        "uncapped_makespan_ms": round(rep_full.makespan_s * 1e3, 3),
+        "capped_makespan_ms": round(rep_cap.makespan_s * 1e3, 3),
+        "slowdown": round(
+            rep_cap.makespan_s / max(rep_full.makespan_s, 1e-12), 3
+        ),
+        "param_loads": rep_cap.param_loads,
+        "param_evictions": rep_cap.param_evictions,
+        "peak_resident_param_gb": round(peak_gb, 4),
+        "budget_respected": bool(peak_gb <= budget_gb * 1.02 + 1e-6),
+        "oracle_ok": bool(full_ok and cap_ok),
+        # throughput while oversubscribed: forward passes per second
+        "capped_forwards_per_s": round(
+            1.0 / max(rep_cap.makespan_s, 1e-12), 3
+        ),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    frac = float(sys.argv[1]) if len(sys.argv) > 1 else 0.3
+    print(json.dumps(measure_streaming(budget_frac=frac), indent=1))

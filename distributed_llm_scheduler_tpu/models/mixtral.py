@@ -9,14 +9,23 @@ placement is exactly the param-cache-locality problem the reference's MRU
 scheduler targets: each expert is a large, independently placeable set of
 weights used by a data-dependent subset of tokens.
 
-TPU/XLA note on routing: task DAGs need static shapes, so experts compute
-**densely** — every expert processes every token and its output is scaled
-by the (possibly zero) top-k gate weight.  That is the standard
-static-shape MoE formulation for XLA (no gather/scatter of variable token
-counts); the fused oracle uses the same math, so DAG execution matches it
-exactly.  The FLOP *estimates* on expert tasks are scaled by top_k/n_experts
-(the useful work) while the dense cost appears in measured calibration —
-the gap is visible, not hidden.
+TPU/XLA note on routing — two static-shape formulations, both first-class:
+
+* **Dense dispatch** (task DAGs, EP sharding, the default oracle): every
+  expert processes every token; its output is scaled by the (possibly
+  zero) top-k gate weight.  Simple, exact, placement-friendly (each
+  expert is one task) — but computes ``n_experts/top_k``x the useful
+  FLOPs.  The FLOP *estimates* on expert tasks are scaled by
+  ``top_k/n_experts`` (the useful work) while the dense cost appears in
+  measured calibration — the gap is visible, not hidden.
+* **Routed dispatch** (:func:`moe_routed`, ``forward(..., routed=True)``):
+  capacity-factor token routing with static capacity buffers — each
+  expert computes only its top-k-assigned tokens up to capacity
+  ``C = ceil(top_k * tokens / n_experts * capacity_factor)``; tokens
+  beyond an expert's capacity are DROPPED (their gate contribution is
+  zero), the standard static-shape sparse-MoE trade (Switch/GShard
+  semantics).  At ``capacity_factor = n_experts/top_k`` nothing can drop
+  and routed output equals dense output exactly (the oracle test).
 """
 
 from __future__ import annotations
@@ -184,6 +193,90 @@ def _moe(block_params: Dict[str, jax.Array], x: jax.Array,
     return moe_combine(w, *outs)
 
 
+def moe_routed(
+    block_params: Dict[str, jax.Array],
+    x: jax.Array,
+    config: MixtralConfig,
+    capacity_factor: float = 2.0,
+    with_stats: bool = False,
+):
+    """Sparse top-k dispatch with static-shape capacity buffers.
+
+    Every shape is static (XLA-compilable): per-expert position comes
+    from a cumulative sum over the flattened (token, slot) assignment
+    order, tokens land in an ``(E, C, D)`` buffer via scatter-add (each
+    kept assignment owns a unique (expert, position) cell), experts run
+    as ONE batched einsum over stacked weights, and outputs gather back
+    weighted by the renormalized top-k gates.  Assignments whose expert
+    is over capacity are dropped — their contribution is zero, exactly
+    the Switch/GShard trade disclosed in the module docstring.  FLOPs
+    scale with ``top_k/n_experts`` (+capacity slack) instead of running
+    every expert on every token.
+
+    Returns ``out`` or ``(out, stats)`` with ``stats = {capacity,
+    dropped_slots, total_slots}`` when ``with_stats``.
+    """
+    B, T, D = x.shape
+    E, k = config.n_experts, config.top_k
+    N = B * T
+    C = min(N, max(1, math.ceil(k * N / E * capacity_factor)))
+    xf = x.reshape(N, D)
+
+    logits = (xf @ block_params["router"]).astype(jnp.float32)  # (N, E)
+    top_vals, top_idx = jax.lax.top_k(logits, k)  # (N, k)
+    top_w = jax.nn.softmax(top_vals, axis=-1).astype(x.dtype)  # (N, k)
+
+    flat_e = top_idx.reshape(-1)  # (N*k,) expert per assignment
+    # position of each assignment within its expert's arrival order
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)  # (N*k, E)
+    pos_all = jnp.cumsum(onehot, axis=0) - 1
+    mypos = jnp.take_along_axis(pos_all, flat_e[:, None], axis=1)[:, 0]
+    keep = mypos < C
+    safe_pos = jnp.where(keep, mypos, C - 1)
+
+    tok_idx = jnp.repeat(jnp.arange(N), k)  # (N*k,)
+    contrib = jnp.where(keep[:, None], xf[tok_idx], 0)
+    buf = jnp.zeros((E, C, D), x.dtype).at[flat_e, safe_pos].add(contrib)
+
+    wg = jnp.stack([block_params[f"e{e}_w_gate"] for e in range(E)])
+    wu = jnp.stack([block_params[f"e{e}_w_up"] for e in range(E)])
+    wd = jnp.stack([block_params[f"e{e}_w_down"] for e in range(E)])
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", buf, wg)) * jnp.einsum(
+        "ecd,edf->ecf", buf, wu
+    )
+    out_buf = jnp.einsum("ecf,efd->ecd", h, wd)  # (E, C, D)
+
+    gathered = out_buf[flat_e, safe_pos]  # (N*k, D)
+    gathered = jnp.where(keep[:, None], gathered, 0)
+    w_flat = top_w.reshape(-1, 1)
+    out = (
+        jnp.zeros((N, D), x.dtype).at[tok_idx].add(gathered * w_flat)
+    ).reshape(B, T, D)
+    if with_stats:
+        stats = {
+            "capacity": C,
+            "dropped_slots": jnp.sum(~keep),
+            "total_slots": N * k,
+        }
+        return out, stats
+    return out
+
+
+def routed_transformer_block(
+    block_params: Dict[str, jax.Array],
+    x: jax.Array,
+    config: MixtralConfig,
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    """:func:`transformer_block` with the routed (capacity-buffer) MoE in
+    place of dense dispatch — identical attention path (shared via
+    :func:`_block_with_moe`), same param layout."""
+    return _block_with_moe(
+        block_params, x, config,
+        lambda bp, h: moe_routed(bp, h, config, capacity_factor),
+    )
+
+
 def moe_block(params: Dict[str, jax.Array], x: jax.Array, layer: int,
               config: MixtralConfig) -> jax.Array:
     """Router + dense experts + combine, as the fused oracle composes it
@@ -207,12 +300,15 @@ def _layer_keys(config: MixtralConfig) -> Tuple[str, ...]:
     return tuple(keys)
 
 
-def transformer_block(
-    block_params: Dict[str, jax.Array], x: jax.Array, config: MixtralConfig
+def _block_with_moe(
+    block_params: Dict[str, jax.Array],
+    x: jax.Array,
+    config: MixtralConfig,
+    moe_fn,
 ) -> jax.Array:
-    """One layer (RMSNorm + GQA + router/experts/combine with residuals),
-    params keyed unprefixed — the rematerialization unit.  Same math as
-    the prefixed :func:`moe_block` path."""
+    """The one attention+residual block body, parameterized by the MoE
+    dispatch (dense :func:`_moe` or :func:`moe_routed`) so the two block
+    variants cannot drift apart on the attention path."""
     h = rms_norm(x, block_params["attn_norm_g"], config.rms_eps)
     h = gqa_attention(
         h, block_params["wq"], block_params["wk"], block_params["wv"],
@@ -221,7 +317,18 @@ def transformer_block(
     )
     x = residual_add(x, h)
     h = rms_norm(x, block_params["ffn_norm_g"], config.rms_eps)
-    return residual_add(x, _moe(block_params, h, config))
+    return residual_add(x, moe_fn(block_params, h))
+
+
+def transformer_block(
+    block_params: Dict[str, jax.Array], x: jax.Array, config: MixtralConfig
+) -> jax.Array:
+    """One layer (RMSNorm + GQA + router/experts/combine with residuals),
+    params keyed unprefixed — the rematerialization unit.  Same math as
+    the prefixed :func:`moe_block` path."""
+    return _block_with_moe(
+        block_params, x, config, lambda bp, h: _moe(bp, h, config)
+    )
 
 
 def forward_with_block(
@@ -257,12 +364,25 @@ def forward(
     input_ids: jax.Array,
     config: MixtralConfig,
     remat: bool = False,
+    routed: bool = False,
+    capacity_factor: float = 2.0,
 ) -> jax.Array:
     """``remat=True`` checkpoints each block — especially valuable for MoE,
     whose dense-dispatch expert activations are ``n_experts`` times the
-    dense model's."""
+    dense model's.  ``routed=True`` switches every layer's MoE to the
+    capacity-buffer sparse dispatch (:func:`moe_routed`) — top_k/n_experts
+    the FLOPs, with the disclosed capacity-drop semantics."""
+    if routed:
+        import functools
+
+        # keyword-frozen capacity keeps the (params, x, config) contract
+        block = functools.partial(
+            routed_transformer_block, capacity_factor=capacity_factor
+        )
+    else:
+        block = transformer_block
     return forward_with_block(
-        params, input_ids, config, transformer_block, _layer_keys(config),
+        params, input_ids, config, block, _layer_keys(config),
         remat=remat,
     )
 
@@ -349,6 +469,17 @@ def loss_fn(
     config: MixtralConfig,
     remat: bool = False,
     scan: bool = False,
+    routed: bool = False,
 ) -> jax.Array:
+    if routed:
+        if scan:
+            raise ValueError(
+                "routed MoE is per-layer (stacked-expert einsums inside "
+                "the block); use scan=False"
+            )
+        return nll_loss(
+            forward(params, input_ids, config, remat=remat, routed=True),
+            targets,
+        )
     fwd = forward_scan if scan else forward
     return nll_loss(fwd(params, input_ids, config, remat=remat), targets)

@@ -319,3 +319,106 @@ def test_choose_cost_model_rejects_pre_method_cache(tmp_path, monkeypatch):
         log=lambda m: None,
     )
     assert suffix == "_cpu"  # fell through to live calibration
+
+
+# -- ICI sensitivity ---------------------------------------------------------
+
+
+def test_ici_sensitivity_structure_and_monotonicity():
+    """Replaying fixed placements under 4x cheaper/dearer ICI must produce
+    a result per scale, and cheaper ICI can only help (or not hurt) the
+    best transfer-crossing makespan."""
+    from distributed_llm_scheduler_tpu import (
+        Cluster,
+        DeviceState,
+        Task,
+        TaskGraph,
+        get_scheduler,
+    )
+    from distributed_llm_scheduler_tpu.backends.sim import LinkModel
+    from distributed_llm_scheduler_tpu.eval.benchlib import ici_sensitivity
+
+    # linear chain with large activations: cross-node edges dominate
+    tasks = [
+        Task(f"t{i}", memory_required=0.5, compute_time=0.01,
+             dependencies=[f"t{i-1}"] if i else [], params_needed=set())
+        for i in range(8)
+    ]
+    graph = TaskGraph(tasks, name="chain").freeze()
+    cluster = Cluster([DeviceState(f"n{i}", 8.0) for i in range(4)])
+    schedules = {
+        name: get_scheduler(name).schedule(graph, cluster)
+        for name in ("roundrobin", "greedy")
+    }
+    link = LinkModel(param_load_gbps=10.0, interconnect_gbps=10.0,
+                     latency_s=1e-6)
+    sens = ici_sensitivity(graph, cluster, schedules, link)
+    assert set(sens) == {"x0.25", "x4"}
+    for v in sens.values():
+        assert v["best_policy"] in schedules
+        assert v["best_makespan_s"] > 0
+    # roundrobin spreads the chain across nodes -> every edge crosses; 16x
+    # bandwidth difference must separate the scaled replays
+    assert (
+        sens["x4"]["best_makespan_s"] <= sens["x0.25"]["best_makespan_s"]
+    )
+
+
+def test_ici_sensitivity_none_interconnect_is_stable():
+    """A link with interconnect_gbps=None (the reference's zero-cost mode)
+    must pass through unscaled rather than crash."""
+    from distributed_llm_scheduler_tpu import (
+        Cluster,
+        DeviceState,
+        Task,
+        TaskGraph,
+        get_scheduler,
+    )
+    from distributed_llm_scheduler_tpu.backends.sim import LinkModel
+    from distributed_llm_scheduler_tpu.eval.benchlib import ici_sensitivity
+
+    tasks = [Task("a", 0.1, 0.01, [], set()), Task("b", 0.1, 0.01, ["a"], set())]
+    graph = TaskGraph(tasks, name="ab").freeze()
+    cluster = Cluster([DeviceState("n0", 4.0), DeviceState("n1", 4.0)])
+    schedules = {"roundrobin": get_scheduler("roundrobin").schedule(graph, cluster)}
+    link = LinkModel(param_load_gbps=None, interconnect_gbps=None)
+    sens = ici_sensitivity(graph, cluster, schedules, link)
+    ms = [v["best_makespan_s"] for v in sens.values()]
+    assert ms[0] == pytest.approx(ms[1])
+
+
+# -- robust numerical oracle -------------------------------------------------
+
+
+def test_oracle_close_f32_strict():
+    import numpy as np
+
+    from distributed_llm_scheduler_tpu.eval.benchlib import oracle_close
+
+    a = np.random.RandomState(0).randn(1000).astype(np.float32)
+    assert oracle_close(a, a, "float32")
+    b = a.copy()
+    b[3] += 1e-2  # one element past f32 tolerance -> strict fail
+    assert not oracle_close(a, b, "float32")
+
+
+def test_oracle_close_bf16_tolerates_tail_outliers():
+    import numpy as np
+
+    from distributed_llm_scheduler_tpu.eval.benchlib import oracle_close
+
+    a = np.random.RandomState(1).randn(4_000_000).astype(np.float32)
+    b = a + np.random.RandomState(2).randn(a.size).astype(np.float32) * 1e-3
+    b[123] = a[123] + 0.2  # a lone rounding-tail outlier
+    assert oracle_close(a, b, "bfloat16")
+
+
+def test_oracle_close_bf16_rejects_systematic_error():
+    import numpy as np
+
+    from distributed_llm_scheduler_tpu.eval.benchlib import oracle_close
+
+    a = np.random.RandomState(3).randn(100_000).astype(np.float32)
+    assert not oracle_close(a, a * 1.1, "bfloat16")  # 10% scale error
+    assert not oracle_close(a, np.roll(a, 1), "bfloat16")  # scrambled
+    assert not oracle_close(a, a.reshape(-1, 1), "bfloat16")  # shape

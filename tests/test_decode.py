@@ -173,3 +173,28 @@ def test_decode_bench_helper_runs():
     assert res["decode_tok_s"] > 0
     assert res["wall_s"] > 0
     assert res["new_tokens"] == 4.0
+
+
+def test_decode_roofline_math():
+    """Roofline bound: pure arithmetic on param + KV-cache bytes over the
+    assumed HBM bandwidth; None on platforms without a published peak."""
+    import jax.numpy as jnp
+
+    from distributed_llm_scheduler_tpu.eval.decode_bench import (
+        PEAK_HBM_GBPS,
+        decode_roofline,
+    )
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config.tiny(dtype=jnp.bfloat16)
+    roof = decode_roofline(cfg, batch=4, cache_len=32, platform="tpu")
+    assert roof is not None
+    # bytes decompose exactly: params + cache read + cache write
+    kv_read = 2 * cfg.n_layer * 4 * cfg.n_head * 32 * cfg.head_dim * 2
+    assert roof["kv_cache_bytes"] == float(kv_read)
+    assert roof["bytes_per_step"] > roof["param_bytes"] + kv_read - 1
+    expect_s = roof["bytes_per_step"] / (PEAK_HBM_GBPS["tpu"] * 1e9)
+    assert roof["step_bound_ms"] == pytest.approx(expect_s * 1e3)
+    assert roof["bound_tok_s"] == pytest.approx(4 / expect_s)
+    # no published bandwidth -> no bound, not a fabricated one
+    assert decode_roofline(cfg, 4, 32, "cpu") is None

@@ -124,6 +124,58 @@ def test_jit_cache_reused_across_runs(mesh_cluster, tiny_setup):
     assert warm < max(rep1.compile_s, 1.0)
 
 
+def test_reps_amortized_makespan(mesh_cluster, tiny_setup):
+    """reps>1 queues the placed run N times with ONE end fence; per-run
+    makespan must agree with the single-shot measurement (loose band:
+    both include host dispatch, which varies run-to-run) and the output
+    must still match the oracle after repeated execution."""
+    dag, params, ids = tiny_setup
+    schedule = get_scheduler("greedy").schedule(dag.graph, mesh_cluster)
+    backend = DeviceBackend(mesh_cluster)
+    backend.execute(dag.graph, schedule, params, ids, warmup=True)
+    single = min(
+        backend.execute(
+            dag.graph, schedule, params, ids, warmup=False
+        ).makespan_s
+        for _ in range(3)
+    )
+    rep = backend.execute(
+        dag.graph, schedule, params, ids, warmup=False, reps=4
+    )
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+    # amortized must be the same order as single-shot: generous bounds
+    # because CPU-mesh host dispatch dominates and jitters under load
+    assert rep.makespan_s < single * 3 + 0.5
+    assert rep.makespan_s > single * 0.1
+    # incompatible modes fail loudly
+    with pytest.raises(ValueError):
+        backend.execute(
+            dag.graph, schedule, params, ids, reps=2, profile=True
+        )
+    with pytest.raises(ValueError):
+        backend.execute(
+            dag.graph, schedule, params, ids, reps=2, stream_params=True
+        )
+
+
+def test_reps_amortized_segmented(mesh_cluster, tiny_setup):
+    """Segment fusion with reps>1: same oracle, same segment count."""
+    dag, params, ids = tiny_setup
+    schedule = get_scheduler("greedy").schedule(dag.graph, mesh_cluster)
+    backend = DeviceBackend(mesh_cluster)
+    rep = backend.execute(
+        dag.graph, schedule, params, ids, segments=True, reps=3
+    )
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+    assert rep.makespan_s > 0
+
+
 def _microbatch_pipeline():
     """2-stage x 2-ops-per-stage x n_mb microbatch chain graph with real
     matmul fns — the shape where dispatch order matters: per-device FIFO

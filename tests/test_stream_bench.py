@@ -1,0 +1,19 @@
+"""Oversubscription probe (eval/stream_bench.py) functional check on CPU."""
+
+import jax.numpy as jnp
+
+from distributed_llm_scheduler_tpu.eval.stream_bench import measure_streaming
+from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+
+def test_measure_streaming_tiny():
+    res = measure_streaming(
+        config=GPT2Config.tiny(), batch=2, seq_len=32, budget_frac=0.3,
+        log=lambda m: None,
+    )
+    assert res["oracle_ok"], res
+    assert res["param_loads"] > 0
+    assert res["param_evictions"] > 0
+    assert res["budget_respected"], res
+    assert res["capped_makespan_ms"] > 0
+    assert res["total_param_gb"] > res["budget_gb"]
