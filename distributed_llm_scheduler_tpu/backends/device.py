@@ -329,6 +329,7 @@ class DeviceBackend:
         segments: bool = False,
         ext_outputs: Optional[Dict[str, Any]] = None,
         streamer: Optional["DeviceBackend._ParamStreamer"] = None,
+        rebatch: bool = True,
     ) -> float:
         """Compile every (fn, placement-device) combination ahead of time;
         returns seconds.
@@ -340,7 +341,8 @@ class DeviceBackend:
         t0 = time.perf_counter()
         if segments:
             self._run_segmented(
-                graph, schedule, placed_params, graph_input, ext_outputs
+                graph, schedule, placed_params, graph_input, ext_outputs,
+                rebatch=rebatch,
             )
         else:
             self._run(
@@ -464,21 +466,42 @@ class DeviceBackend:
         return segments
 
     def _segment_callable(self, graph: TaskGraph, tids: Tuple[str, ...],
-                          exports: Tuple[str, ...]):
+                          exports: Tuple[str, ...],
+                          rebatch: bool = True):
         """One jitted fn running ``tids`` in order: (params-by-global-name,
         external-inputs-by-task-id) -> {export tid: output}.
 
-        Cached per (graph, tids, exports): the graph key (a WeakKey, so
-        dead graphs release their executables) prevents a backend reused
-        across graphs with colliding task ids from running stale fns, and
-        ``exports`` is part of the key because the same run under a
-        different downstream placement must return a different output set.
+        Cached per (graph, tids, exports, rebatch): the graph key (a
+        WeakKey, so dead graphs release their executables) prevents a
+        backend reused across graphs with colliding task ids from running
+        stale fns, and ``exports`` is part of the key because the same run
+        under a different downstream placement must return a different
+        output set.
+
+        ``rebatch=True`` applies the segment re-batching pass
+        (:mod:`.rebatch`): sibling tasks (isomorphic microbatch chains)
+        marked batch-axis-0 polymorphic execute as ONE call on
+        concatenated inputs — recovering the fused forward's full-batch
+        op shapes that the microbatch split fragments.  Placement,
+        transfers, and the export contract are unchanged; graphs with no
+        eligible siblings compile to exactly the unbatched program.
         """
         per_graph = self._seg_cache.setdefault(graph, {})
-        key = (tids, exports)
+        key = (tids, exports, rebatch)
         fn = per_graph.get(key)
         if fn is not None:
             return fn
+
+        if rebatch:
+            from .rebatch import build_rebatched_seg_fn, plan_rebatch
+
+            plan = plan_rebatch(graph, tids)
+            if plan.classes:
+                fn = jax.jit(
+                    build_rebatched_seg_fn(graph, tids, exports, plan)
+                )
+                per_graph[key] = fn
+                return fn
 
         # extract per-task (fn, params, args) up front: the closure must
         # NOT capture `graph`, or the cache value would strongly reference
@@ -518,6 +541,7 @@ class DeviceBackend:
         graph_input: Any,
         ext_outputs: Optional[Dict[str, Any]] = None,
         fence: bool = True,
+        rebatch: bool = True,
     ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
         """Segment-fused execution: same placement, one launch per segment.
         Tasks with failed upstreams are dropped at segment-build time (host
@@ -566,7 +590,7 @@ class DeviceBackend:
                         ext[d] = x
             if needs_input:
                 ext["__input__"] = jax.device_put(graph_input, dev)
-            fn = self._segment_callable(graph, tids, exports)
+            fn = self._segment_callable(graph, tids, exports, rebatch)
             outputs.update(fn(union, ext))
 
         n_fences = 0
@@ -704,6 +728,7 @@ class DeviceBackend:
         keep_outputs: bool = False,
         stream_params: bool = False,
         reps: int = 1,
+        rebatch: bool = True,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
@@ -770,6 +795,8 @@ class DeviceBackend:
                 "compiles the per-param load points away); run without "
                 "segments"
             )
+        if reps < 1:
+            raise ValueError(f"reps must be >= 1, got {reps}")
         if reps > 1 and (profile or stream_params):
             raise ValueError(
                 "reps > 1 amortizes over identical repeated runs; profile "
@@ -803,6 +830,7 @@ class DeviceBackend:
                     self._ParamStreamer(self.cluster, params)
                     if stream_params else None
                 ),
+                rebatch=rebatch,
             )
 
         # fence round-trip, re-measured per execute (outside the timed
@@ -824,7 +852,7 @@ class DeviceBackend:
                 output, timings, tedges, tbytes, n_fences, n_disp, touts = (
                     self._run_segmented(
                         graph, schedule, placed, graph_input, ext_outputs,
-                        fence=fence,
+                        fence=fence, rebatch=rebatch,
                     )
                 )
             else:

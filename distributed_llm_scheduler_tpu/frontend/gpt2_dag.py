@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from ..core.graph import Task, TaskGraph
+from ..core.graph import Task, TaskGraph, mark_batch0, mark_concat0
 from ..models import gpt2
 from ..models.gpt2 import GPT2Config
 from .vocab_sharding import logit_concat_fn, make_embed_partial_fn, shard_bounds
@@ -222,6 +222,12 @@ def build_gpt2_dag(
 
         return f_embedding
 
+    # batch-axis-0-polymorphic ops are marked for the segment re-batching
+    # pass (backends/rebatch.py): per-token math, safe to run on sibling
+    # microbatches' concatenated inputs.  f_concat (axis-0 concat) and the
+    # embedding roots (static batch-slice closures) are deliberately NOT
+    # marked.
+    @mark_batch0
     def f_embed_combine(p, *partials):
         T_ = partials[0].shape[-2]
         out = partials[0]
@@ -229,32 +235,41 @@ def build_gpt2_dag(
             out = out + part
         return out + p["wpe"][:T_]
 
+    @mark_concat0
     def f_concat(p, *chunks):
         return jnp.concatenate(chunks, axis=0)
 
+    @mark_batch0
     def f_ln(p, x):
         return gpt2.layer_norm(x, p["g"], p["b"], eps)
 
+    @mark_batch0
     def f_attn(p, x):
         return gpt2.causal_attention(
             x, p["qkv_w"], p["qkv_b"], p["proj_w"], p["proj_b"], config.n_head
         )
 
+    @mark_batch0
     def f_residual(p, a, b):
         return gpt2.residual_add(a, b)
 
+    @mark_batch0
     def f_ffn_expand(p, x):
         return gpt2.ffn_expand(x, p["fc_w"], p["fc_b"])
 
+    @mark_batch0
     def f_ffn_act(p, x):
         return gpt2.ffn_activation(x)
 
+    @mark_batch0
     def f_ffn_contract(p, x):
         return gpt2.ffn_contract(x, p["proj_w"], p["proj_b"])
 
+    @mark_batch0
     def f_output_projection(p, x):
         return gpt2.output_projection(x, p["wte"])
 
+    @mark_batch0
     def f_logit_shard(p, x):
         """Logit slice via the tied table's row shard: x @ shard.T — runs
         wherever the embedding parked that shard, so the tied table is

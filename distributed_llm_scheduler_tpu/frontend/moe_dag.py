@@ -25,6 +25,7 @@ from typing import Optional
 from ..models import mixtral
 from ..models.mixtral import MixtralConfig
 from .backbone import build_decoder_dag
+from ..core.graph import mark_batch0
 from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, graph_name_tags
 
 
@@ -46,12 +47,15 @@ def build_moe_dag(
     Bm = batch // microbatches
     T = seq_len
 
+    @mark_batch0
     def f_router(p, x):
         return mixtral.router_weights(x, p["w"], config.top_k)
 
+    @mark_batch0
     def f_expert(p, x):
         return mixtral.expert_ffn(x, p["w_gate"], p["w_up"], p["w_down"])
 
+    @mark_batch0
     def f_combine(p, weights, *outs):
         return mixtral.moe_combine(weights, *outs)
 

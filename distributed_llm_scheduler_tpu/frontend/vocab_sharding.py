@@ -15,6 +15,8 @@ from typing import Callable, List
 
 import jax.numpy as jnp
 
+from ..core.graph import mark_batch0
+
 
 def shard_bounds(vocab_size: int, shards: int) -> List[int]:
     """Balanced split boundaries: ``shards + 1`` cumulative offsets where the
@@ -48,6 +50,7 @@ def make_embed_partial_fn(
     return f_embed_partial
 
 
+@mark_batch0  # last-axis concat: batch-axis-0 polymorphic
 def logit_concat_fn(p, *slices):
     """Concatenate per-shard logit slices along the vocab axis."""
     return jnp.concatenate(slices, axis=-1)

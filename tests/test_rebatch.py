@@ -1,0 +1,235 @@
+"""Segment re-batching (backends/rebatch.py): plan properties + oracles.
+
+The pass folds isomorphic microbatch-sibling tasks into full-batch ops
+inside a segment program.  Correctness contract: identical outputs to the
+unbatched segment program (and to the fused forward), for any placement;
+plans must only batch marked fns, mutually independent members, and
+aligned argument structures.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+from distributed_llm_scheduler_tpu.backends.rebatch import plan_rebatch
+from distributed_llm_scheduler_tpu.core.fusion import fuse_linear_chains
+from distributed_llm_scheduler_tpu.core.graph import is_batch0, mark_batch0
+from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+
+@pytest.fixture(scope="module")
+def mb_setup():
+    dag = build_gpt2_dag(
+        GPT2Config.tiny(), batch=8, seq_len=32, microbatches=8,
+        vocab_shards=4,
+    )
+    graph = fuse_linear_chains(dag.graph)
+    return dag, graph, dag.init_params(), dag.make_inputs()
+
+
+def _single_segment(graph, cluster):
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(graph, cluster)
+    order = backend.dispatch_order(graph, sched)
+    segs = backend.build_segments(graph, sched, order)
+    return backend, sched, segs
+
+
+def test_marker_propagates_through_fusion(mb_setup):
+    dag, graph, _, _ = mb_setup
+    # unfused per-op fns are marked; fused composites inherit
+    marked = [t.task_id for t in graph if t.fn is not None and is_batch0(t.fn)]
+    assert len(marked) > len(graph) // 2, "most tasks should be batchable"
+    # the microbatch output concat must NOT be marked (axis-0 concat)
+    assert not is_batch0(graph["output_concat"].fn)
+
+
+def test_plan_batches_microbatch_siblings(mb_setup):
+    dag, graph, _, _ = mb_setup
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend, sched, segs = _single_segment(graph, cluster)
+    (node, tids, exports), = segs
+    plan = plan_rebatch(graph, tids)
+    assert plan.classes, "flagship structure must produce batched classes"
+    # every class: 8 microbatch members, mutually distinct, marked fns
+    for members in plan.classes:
+        assert len(members) == 8
+        assert len(set(members)) == 8
+        fns = {id(graph[m].fn) for m in members}
+        assert len(fns) == 1
+        assert is_batch0(graph[members[0]].fn)
+    # batched tasks cover the per-layer chains (non-root, non-concat)
+    assert plan.n_batched_tasks >= len(tids) * 2 // 3
+    # units respect dependencies: sources appear before consumers
+    seen = set()
+    member_unit = {}
+    for ui, (kind, val) in enumerate(plan.units):
+        ts = plan.classes[val] if kind == "batched" else (val,)
+        for t in ts:
+            member_unit[t] = ui
+    for ui, (kind, val) in enumerate(plan.units):
+        ts = plan.classes[val] if kind == "batched" else (val,)
+        for t in ts:
+            for d in graph[t].arg_tasks or graph[t].dependencies:
+                if d in member_unit and member_unit[d] != ui:
+                    assert member_unit[d] < ui, (t, d)
+
+
+def test_rebatched_oracle_single_device(mb_setup):
+    dag, graph, params, ids = mb_setup
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend, sched, _ = _single_segment(graph, cluster)
+    rep = backend.execute(graph, sched, params, ids, segments=True)
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+    # and identical to the unbatched segment program
+    rep0 = backend.execute(
+        graph, sched, params, ids, segments=True, rebatch=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(rep0.output), np.asarray(rep.output), rtol=2e-5,
+        atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("policy", ["pipeline", "roundrobin", "mru"])
+def test_rebatched_oracle_multi_device(mb_setup, policy):
+    """Multi-device placements: segments see partial sibling sets and ext
+    inputs from other devices; re-batching must stay exact."""
+    dag, graph, params, ids = mb_setup
+    cluster = Cluster.from_jax_devices(hbm_cap_gb=4.0)
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler(policy).schedule(graph, cluster)
+    assert not sched.failed
+    rep = backend.execute(graph, sched, params, ids, segments=True)
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_no_siblings_degrades_to_linear():
+    """mb=1 graph: nothing to batch; plan must be empty and execution
+    identical."""
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=16)
+    graph = fuse_linear_chains(dag.graph)
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend, sched, segs = _single_segment(graph, cluster)
+    (node, tids, exports), = segs
+    plan = plan_rebatch(graph, tids)
+    assert plan.classes == ()
+    params, ids = dag.init_params(), dag.make_inputs()
+    rep = backend.execute(graph, sched, params, ids, segments=True)
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_unmarked_fns_never_batch():
+    """A graph whose fns lack the marker must plan all-singles even with
+    perfect siblings."""
+    dag = build_gpt2_dag(
+        GPT2Config.tiny(), batch=4, seq_len=16, microbatches=4
+    )
+    graph = dag.graph  # unfused
+    # strip markers by wrapping fns in unmarked lambdas
+    for t in graph:
+        if t.fn is not None:
+            orig = t.fn
+            t.fn = lambda p, *a, _f=orig: _f(p, *a)
+    plan = plan_rebatch(graph, list(graph.topo_order))
+    assert plan.classes == ()
+
+
+def test_mark_batch0_roundtrip():
+    def f(p, x):
+        return x
+
+    assert not is_batch0(f)
+    assert is_batch0(mark_batch0(f))
+
+
+@pytest.mark.parametrize("family", ["llama", "moe"])
+def test_rebatch_other_families(family):
+    """Llama and Mixtral DAGs batch their microbatch siblings too (the
+    markers live in the shared backbone + family ffn sections)."""
+    if family == "llama":
+        from distributed_llm_scheduler_tpu.frontend.llama_dag import (
+            build_llama_dag,
+        )
+        from distributed_llm_scheduler_tpu.models.llama import LlamaConfig
+
+        dag = build_llama_dag(
+            LlamaConfig.tiny(), batch=4, seq_len=16, microbatches=4,
+            vocab_shards=2,
+        )
+    else:
+        from distributed_llm_scheduler_tpu.frontend.moe_dag import (
+            build_moe_dag,
+        )
+        from distributed_llm_scheduler_tpu.models.mixtral import (
+            MixtralConfig,
+        )
+
+        dag = build_moe_dag(
+            MixtralConfig.tiny(), batch=4, seq_len=16, microbatches=4
+        )
+    graph = fuse_linear_chains(dag.graph)
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(graph, cluster)
+    order = backend.dispatch_order(graph, sched)
+    (node, tids, exports), = backend.build_segments(graph, sched, order)
+    plan = plan_rebatch(graph, tids)
+    assert plan.classes, f"{family}: no batched classes"
+    assert plan.n_batched_tasks > len(tids) // 2
+    params, ids = dag.init_params(), dag.make_inputs()
+    rep = backend.execute(graph, sched, params, ids, segments=True)
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_permuted_param_alias_never_merges():
+    """Two tasks with the same fn but swapped local->global alias maps
+    must NOT merge: the batched call binds member[0]'s mapping, which
+    would silently run member 1 with swapped weights."""
+    import jax.numpy as jnp
+
+    from distributed_llm_scheduler_tpu import Task, TaskGraph
+
+    @mark_batch0
+    def f(p, x):
+        return x @ p["a"] + 10.0 * (x @ p["b"])
+
+    spec = jax.ShapeDtypeStruct((2, 4), jnp.float32)
+    root_spec = jax.ShapeDtypeStruct((2, 4), jnp.float32)
+
+    def mk(tid, alias, deps):
+        return Task(
+            tid, 0.01, 0.01, deps, set(alias.values()),
+            param_bytes={g: 64 for g in alias.values()},
+            fn=f, arg_tasks=deps, param_alias=alias, out_shape=spec,
+        )
+
+    @mark_batch0
+    def root_fn(p, x):
+        return x * 1.0
+
+    r1 = Task("r1", 0.01, 0.01, [], set(), fn=root_fn, arg_tasks=[],
+              out_shape=root_spec)
+    r2 = Task("r2", 0.01, 0.01, [], set(), fn=lambda p, x: x * 2.0,
+              arg_tasks=[], out_shape=root_spec)
+    t1 = mk("t1", {"a": "g1", "b": "g2"}, ["r1"])
+    t2 = mk("t2", {"b": "g1", "a": "g2"}, ["r2"])
+    graph = TaskGraph([r1, r2, t1, t2], name="alias").freeze()
+    plan = plan_rebatch(graph, ["r1", "r2", "t1", "t2"])
+    for members in plan.classes:
+        assert not {"t1", "t2"} <= set(members), "permuted aliases merged"
