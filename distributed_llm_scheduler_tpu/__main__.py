@@ -505,6 +505,76 @@ def cmd_generate(args) -> int:
         return 2
     ids = jnp.asarray([prompt], dtype=jnp.int32)
 
+    if getattr(args, "task_graph", False):
+        # inference through the scheduling layer (frontend/decode_dag):
+        # prefill + per-token decode-step DAGs, placed by --scheduler,
+        # functional cache updates between steps.  Greedy only (the step
+        # DAG exports logits; sampling would add a host RNG loop).
+        if not args.model.startswith("gpt2"):
+            print("--task-graph generation supports the gpt2 family",
+                  file=sys.stderr)
+            return 2
+        if args.temperature != 0.0:
+            print("--task-graph generation is greedy; drop --temperature",
+                  file=sys.stderr)
+            return 2
+        import numpy as np
+
+        from .backends.device import DeviceBackend
+        from .frontend.decode_dag import apply_cache_updates, build_decode_dag
+
+        max_len = len(prompt) + args.max_new_tokens
+        if max_len > config.n_positions:
+            # same clean error the whole-program path produces
+            print(f"prompt ({len(prompt)}) + max_new_tokens "
+                  f"({args.max_new_tokens}) exceeds the model's position "
+                  f"limit {config.n_positions}", file=sys.stderr)
+            return 2
+        cfg = _config_from(args)
+        cluster = cfg.build_cluster_with_devices()
+        backend = DeviceBackend(cluster)
+        new = []
+        tok_ids = ids
+        pos = 0
+        # weights + zero cache slabs, allocated ONCE (shapes are fixed by
+        # max_len); each step's updates fold back in functionally
+        params_c = dict(params)
+        H, hd = config.n_head, config.head_dim
+        for i in range(config.n_layer):
+            for kind in ("k", "v"):
+                params_c[f"cache_{kind}_{i}"] = jnp.zeros(
+                    (1, H, max_len, hd), config.dtype
+                )
+        for step in range(args.max_new_tokens):
+            step_len = tok_ids.shape[1]
+            ddag = build_decode_dag(
+                config, batch=1, step_len=step_len, pos=pos, max_len=max_len
+            )
+            sched = cfg.build_scheduler().schedule(ddag.graph, cluster)
+            if sched.failed:
+                print(f"decode step {step}: {len(sched.failed)} tasks "
+                      "failed to place", file=sys.stderr)
+                return 1
+            rep = backend.execute(
+                ddag.graph, sched, params_c, tok_ids, keep_outputs=True
+            )
+            nxt = int(np.asarray(rep.output)[0, -1, :].argmax())
+            new.append(nxt)
+            tok_ids = jnp.asarray([[nxt]], dtype=jnp.int32)
+            if step < args.max_new_tokens - 1:  # last step's update unused
+                params_c = apply_cache_updates(
+                    params_c, rep.task_outputs, config, pos=pos
+                )
+            pos += step_len
+        print(json.dumps({
+            "model": args.model,
+            "prompt_ids": prompt,
+            "generated_ids": new,
+            "task_graph": True,
+            "scheduler": cfg.scheduler,
+        }))
+        return 0
+
     try:
         out = mod.generate(
             params, ids, config, max_new_tokens=args.max_new_tokens,
@@ -662,6 +732,14 @@ def main(argv=None) -> int:
                         "Llama / Mixtral weights (HF layout); random "
                         "init when omitted")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--task-graph", action="store_true", dest="task_graph",
+                   help="generate through the scheduling layer: per-step "
+                        "decode DAGs (KV-cache slabs as placeable params) "
+                        "placed by --scheduler and executed on live "
+                        "devices; greedy sampling, gpt2 family")
+    p.add_argument("--scheduler", default="heft")
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--hbm-gb", type=float, default=14.0)
     p.set_defaults(fn=cmd_generate)
 
     p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")

@@ -251,6 +251,14 @@ class RunConfig:
         raise ValueError(f"unknown backend {self.backend!r}")
 
     def build_cluster_with_devices(self):
+        import jax
+
         from ..core.cluster import Cluster
 
-        return Cluster.from_jax_devices(hbm_cap_gb=self.hbm_gb)
+        # honor num_nodes by taking a prefix of the live devices — the
+        # flag was silently dead for live clusters (all devices always
+        # bound), which made `--num-nodes 4` a lie on an 8-device host
+        devs = jax.devices()
+        if self.num_nodes and self.num_nodes < len(devs):
+            devs = devs[: self.num_nodes]
+        return Cluster.from_jax_devices(devs, hbm_cap_gb=self.hbm_gb)

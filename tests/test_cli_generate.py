@@ -161,3 +161,23 @@ def test_execute_inject_failure_full_completion_edge():
     assert r.returncode == 0, r.stderr
     rec = json.loads(r.stdout)["recovery"]
     assert rec["output_matches_uninterrupted"] is True
+
+
+def test_generate_task_graph_matches_whole_program():
+    """--task-graph routes generation through per-step decode DAGs placed
+    by the scheduler; greedy tokens must equal the whole-program path."""
+    plain = _run(
+        "--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+        "--max-new-tokens", "3", timeout=400,
+    )
+    assert plain.returncode == 0, plain.stderr
+    tg = _run(
+        "--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+        "--max-new-tokens", "3", "--task-graph", "--scheduler", "mru",
+        "--num-nodes", "4", timeout=400,
+    )
+    assert tg.returncode == 0, tg.stderr
+    a = json.loads(plain.stdout)
+    b = json.loads(tg.stdout)
+    assert b["task_graph"] is True
+    assert a["generated_ids"] == b["generated_ids"]

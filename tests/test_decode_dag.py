@@ -1,0 +1,120 @@
+"""Decode step as a task DAG (frontend/decode_dag.py): the scheduling
+layer sees an inference workload (VERDICT r2 missing #4).
+
+Pins: prefill-step DAG logits == models/decode cached forward; decode-step
+DAG at pos>0 stays exact over a multi-step loop with functional cache
+updates; cache slabs are real placeable params the scheduler accounts;
+multi-device placed execution matches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, get_scheduler, validate_schedule
+from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+    apply_cache_updates,
+    build_decode_dag,
+)
+from distributed_llm_scheduler_tpu.models import gpt2
+from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+CFG = GPT2Config.tiny()
+B, P, M = 2, 8, 32
+
+
+def _prompt():
+    return jax.random.randint(
+        jax.random.PRNGKey(1), (B, P), 0, CFG.vocab_size, dtype=jnp.int32
+    )
+
+
+def test_cache_slabs_are_placeable_params():
+    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    g = dag.graph
+    for i in range(CFG.n_layer):
+        t = g[f"layer_{i}"]
+        assert f"cache_k_{i}" in t.params_needed
+        assert f"cache_v_{i}" in t.params_needed
+        # real bytes: B x H x M x hd x itemsize
+        expect = B * CFG.n_head * M * CFG.head_dim * 4
+        assert t.param_bytes[f"cache_k_{i}"] == expect
+
+
+def test_prefill_dag_matches_cached_forward():
+    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    params = dag.init_params()
+    ids = _prompt()
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    rep = backend.execute(dag.graph, sched, params, ids)
+    want = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(want), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_multistep_decode_loop_token_exact():
+    """Prefill DAG + per-token decode DAGs with functional cache updates
+    must reproduce models/decode.generate greedy tokens exactly."""
+    ids = _prompt()
+    model_params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    n_new = 3
+    want = gpt2.generate(model_params, ids, CFG, max_new_tokens=n_new)
+
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+
+    # prefill at pos 0
+    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    params = dag.init_params()
+    params.update(model_params)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    rep = backend.execute(dag.graph, sched, params, ids, keep_outputs=True)
+    params = apply_cache_updates(params, rep.task_outputs, CFG, pos=0)
+    tok = jnp.argmax(np.asarray(rep.output)[:, -1, :], axis=-1)
+    got = [tok]
+
+    # token-by-token decode steps
+    for s in range(1, n_new):
+        pos = P + s - 1
+        ddag = build_decode_dag(CFG, batch=B, step_len=1, pos=pos, max_len=M)
+        dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
+        drep = backend.execute(
+            ddag.graph, dsched, params, tok[:, None].astype(jnp.int32),
+            keep_outputs=True,
+        )
+        params = apply_cache_updates(params, drep.task_outputs, CFG, pos=pos)
+        tok = jnp.argmax(np.asarray(drep.output)[:, -1, :], axis=-1)
+        got.append(tok)
+
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_array_equal(np.asarray(want[:, P:P + n_new]),
+                                  np.asarray(got))
+
+
+@pytest.mark.parametrize("policy", ["mru", "roundrobin"])
+def test_decode_dag_multi_device(policy):
+    """Placed decode step on the 8-device mesh: cache slabs distribute,
+    validator passes, logits exact."""
+    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    params = dag.init_params()
+    ids = _prompt()
+    cluster = Cluster.from_jax_devices(hbm_cap_gb=4.0)
+    sched = get_scheduler(policy).schedule(dag.graph, cluster)
+    assert not sched.failed
+    vrep = validate_schedule(dag.graph, cluster, sched)
+    assert vrep.ok
+    rep = DeviceBackend(cluster).execute(dag.graph, sched, params, ids)
+    want = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(want), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_position_bounds_checked():
+    with pytest.raises(ValueError):
+        build_decode_dag(CFG, batch=1, step_len=8, pos=30, max_len=32)
