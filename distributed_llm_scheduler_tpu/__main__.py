@@ -505,6 +505,22 @@ def cmd_generate(args) -> int:
         return 2
     ids = jnp.asarray([prompt], dtype=jnp.int32)
 
+    _sched_flags = {
+        k: getattr(args, k) for k in ("scheduler", "num_nodes", "hbm_gb")
+    }
+    if not getattr(args, "task_graph", False):
+        passed = [k for k, v in _sched_flags.items() if v is not None]
+        if passed:
+            print(f"--{'/--'.join(p.replace('_', '-') for p in passed)} "
+                  "only apply with --task-graph (the whole-program decode "
+                  "loop does no scheduling)", file=sys.stderr)
+            return 2
+    else:
+        # real defaults for the scheduled path
+        args.scheduler = args.scheduler or "heft"
+        args.num_nodes = args.num_nodes or 1
+        args.hbm_gb = args.hbm_gb if args.hbm_gb is not None else 14.0
+
     if getattr(args, "task_graph", False):
         # inference through the scheduling layer (frontend/decode_dag):
         # prefill + per-token decode-step DAGs, placed by --scheduler,
@@ -737,9 +753,12 @@ def main(argv=None) -> int:
                         "decode DAGs (KV-cache slabs as placeable params) "
                         "placed by --scheduler and executed on live "
                         "devices; greedy sampling, gpt2 family")
-    p.add_argument("--scheduler", default="heft")
-    p.add_argument("--num-nodes", type=int, default=1)
-    p.add_argument("--hbm-gb", type=float, default=14.0)
+    # None defaults so flags passed WITHOUT --task-graph fail fast
+    # (the whole-program path does no scheduling; silent acceptance
+    # would be a dead-flag lie)
+    p.add_argument("--scheduler", default=None)
+    p.add_argument("--num-nodes", type=int, default=None)
+    p.add_argument("--hbm-gb", type=float, default=None)
     p.set_defaults(fn=cmd_generate)
 
     p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")

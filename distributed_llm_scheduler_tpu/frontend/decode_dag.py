@@ -36,9 +36,7 @@ from ..core.graph import Task, TaskGraph
 from ..models import decode as _decode
 from ..models import gpt2
 from ..models.gpt2 import GPT2Config
-from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, _bytes_of, make_task_adder
-
-_GB = 1024**3
+from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, make_task_adder
 
 
 def build_decode_dag(

@@ -261,4 +261,14 @@ class RunConfig:
         devs = jax.devices()
         if self.num_nodes and self.num_nodes < len(devs):
             devs = devs[: self.num_nodes]
+        elif self.num_nodes and self.num_nodes > len(devs):
+            # live clusters cannot invent devices; disclose the clamp
+            # instead of silently reporting an un-honored request
+            import sys
+
+            print(
+                f"note: {self.num_nodes} nodes requested but only "
+                f"{len(devs)} live device(s) exist; binding {len(devs)}",
+                file=sys.stderr,
+            )
         return Cluster.from_jax_devices(devs, hbm_cap_gb=self.hbm_gb)

@@ -242,6 +242,16 @@ def quantize_dag(dag: Any, min_elems: int = 4096) -> Any:
                 }
                 return _fn(deq, *args)
 
+            # dequant is per-param (broadcast under batching), so the
+            # wrapper preserves batch-axis-0 polymorphism / concat
+            # semantics — without this, quantized graphs lose segment
+            # re-batching (markers live on the fn object)
+            from ..core.graph import is_batch0, is_concat0, mark_batch0, mark_concat0
+
+            if is_batch0(fn):
+                mark_batch0(w)
+            if is_concat0(fn):
+                mark_concat0(w)
             wrapped[key] = w
         return w
 

@@ -233,3 +233,35 @@ def test_permuted_param_alias_never_merges():
     plan = plan_rebatch(graph, ["r1", "r2", "t1", "t2"])
     for members in plan.classes:
         assert not {"t1", "t2"} <= set(members), "permuted aliases merged"
+
+
+def test_rebatch_composes_with_quantization():
+    """int8 dequant wrappers preserve the batch0 marker (dequant is
+    per-param, broadcast under batching), so quantized graphs keep
+    sibling folding — and the quantized oracle stays exact."""
+    import dataclasses
+
+    from distributed_llm_scheduler_tpu import quantize_dag
+
+    dag = build_gpt2_dag(
+        GPT2Config.tiny(), batch=4, seq_len=32, microbatches=4,
+        vocab_shards=2,
+    )
+    qdag = quantize_dag(
+        dataclasses.replace(dag, graph=fuse_linear_chains(dag.graph))
+    )
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(qdag.graph, cluster)
+    order = backend.dispatch_order(qdag.graph, sched)
+    (node, tids, exports), = backend.build_segments(qdag.graph, sched, order)
+    plan = plan_rebatch(qdag.graph, tids)
+    assert plan.n_batched_tasks > len(tids) // 2, (
+        f"quantized graph lost batching: {plan.n_batched_tasks}/{len(tids)}"
+    )
+    params, ids = qdag.init_params(), qdag.make_inputs()
+    rep = backend.execute(qdag.graph, sched, params, ids, segments=True)
+    fused = qdag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-4, atol=2e-4
+    )
