@@ -464,6 +464,25 @@ def cmd_train(args) -> int:
 
 
 def cmd_generate(args) -> int:
+    # flag validation FIRST — before config resolution, checkpoint
+    # loading, or any device-touching work: scheduling flags without
+    # --task-graph are dead (the whole-program loop does no scheduling),
+    # and --task-graph sampling is greedy-only
+    if not getattr(args, "task_graph", False):
+        passed = [
+            k for k in ("scheduler", "num_nodes", "hbm_gb")
+            if getattr(args, k) is not None
+        ]
+        if passed:
+            print(f"--{'/--'.join(p.replace('_', '-') for p in passed)} "
+                  "only apply with --task-graph (the whole-program decode "
+                  "loop does no scheduling)", file=sys.stderr)
+            return 2
+    elif args.temperature != 0.0:
+        print("--task-graph generation is greedy; drop --temperature",
+              file=sys.stderr)
+        return 2
+
     import jax
     import jax.numpy as jnp
 
@@ -505,46 +524,35 @@ def cmd_generate(args) -> int:
         return 2
     ids = jnp.asarray([prompt], dtype=jnp.int32)
 
-    _sched_flags = {
-        k: getattr(args, k) for k in ("scheduler", "num_nodes", "hbm_gb")
-    }
-    if not getattr(args, "task_graph", False):
-        passed = [k for k, v in _sched_flags.items() if v is not None]
-        if passed:
-            print(f"--{'/--'.join(p.replace('_', '-') for p in passed)} "
-                  "only apply with --task-graph (the whole-program decode "
-                  "loop does no scheduling)", file=sys.stderr)
-            return 2
-    else:
-        # real defaults for the scheduled path
-        args.scheduler = args.scheduler or "heft"
-        args.num_nodes = args.num_nodes or 1
-        args.hbm_gb = args.hbm_gb if args.hbm_gb is not None else 14.0
-
     if getattr(args, "task_graph", False):
         # inference through the scheduling layer (frontend/decode_dag):
         # prefill + per-token decode-step DAGs, placed by --scheduler,
         # functional cache updates between steps.  Greedy only (the step
         # DAG exports logits; sampling would add a host RNG loop).
-        if not args.model.startswith("gpt2"):
-            print("--task-graph generation supports the gpt2 family",
-                  file=sys.stderr)
-            return 2
-        if args.temperature != 0.0:
-            print("--task-graph generation is greedy; drop --temperature",
-                  file=sys.stderr)
-            return 2
+        # Real defaults for the scheduled path (None = not passed):
+        if args.scheduler is None:
+            args.scheduler = "heft"
+        if args.num_nodes is None:
+            args.num_nodes = 1
+        if args.hbm_gb is None:
+            args.hbm_gb = 14.0
         import numpy as np
 
         from .backends.device import DeviceBackend
-        from .frontend.decode_dag import apply_cache_updates, build_decode_dag
+        from .frontend.decode_dag import (
+            apply_cache_updates,
+            build_decode_dag_any,
+            cache_dims,
+        )
+        from .models.decode import _position_limit
 
         max_len = len(prompt) + args.max_new_tokens
-        if max_len > config.n_positions:
+        limit = _position_limit(config)
+        if limit and max_len > limit:
             # same clean error the whole-program path produces
             print(f"prompt ({len(prompt)}) + max_new_tokens "
                   f"({args.max_new_tokens}) exceeds the model's position "
-                  f"limit {config.n_positions}", file=sys.stderr)
+                  f"limit {limit}", file=sys.stderr)
             return 2
         cfg = _config_from(args)
         cluster = cfg.build_cluster_with_devices()
@@ -555,15 +563,15 @@ def cmd_generate(args) -> int:
         # weights + zero cache slabs, allocated ONCE (shapes are fixed by
         # max_len); each step's updates fold back in functionally
         params_c = dict(params)
-        H, hd = config.n_head, config.head_dim
-        for i in range(config.n_layer):
+        n_layers, nkv, hd = cache_dims(config)
+        for i in range(n_layers):
             for kind in ("k", "v"):
                 params_c[f"cache_{kind}_{i}"] = jnp.zeros(
-                    (1, H, max_len, hd), config.dtype
+                    (1, nkv, max_len, hd), config.dtype
                 )
         for step in range(args.max_new_tokens):
             step_len = tok_ids.shape[1]
-            ddag = build_decode_dag(
+            ddag = build_decode_dag_any(
                 config, batch=1, step_len=step_len, pos=pos, max_len=max_len
             )
             sched = cfg.build_scheduler().schedule(ddag.graph, cluster)
@@ -752,7 +760,7 @@ def main(argv=None) -> int:
                    help="generate through the scheduling layer: per-step "
                         "decode DAGs (KV-cache slabs as placeable params) "
                         "placed by --scheduler and executed on live "
-                        "devices; greedy sampling, gpt2 family")
+                        "devices; greedy sampling, all three families")
     # None defaults so flags passed WITHOUT --task-graph fail fast
     # (the whole-program path does no scheduling; silent acceptance
     # would be a dead-flag lie)

@@ -20,8 +20,12 @@ slabs are placeable parameters**:
   owns the traced-position `lax.scan` generation loop; this path exists
   so placement policies can reason about and execute inference steps.
 
-GPT-2 family.  Oracle: ``models/gpt2.forward_cached`` on the same cache
-(logits exact, written cache rows exact — ``tests/test_decode_dag.py``).
+All three families: :func:`build_decode_dag` (GPT-2),
+:func:`build_backbone_decode_dag` (Llama / Mixtral — GQA cache layout,
+RoPE at the static step position, MoE routing per step), and the
+dispatching :func:`build_decode_dag_any`.  Oracle: the family's
+``forward_cached`` on the same cache (logits exact, multi-step greedy
+tokens exact — ``tests/test_decode_dag.py``).
 """
 
 from __future__ import annotations
@@ -37,6 +41,18 @@ from ..models import decode as _decode
 from ..models import gpt2
 from ..models.gpt2 import GPT2Config
 from .gpt2_dag import DEFAULT_EFFECTIVE_FLOPS, ModelDAG, make_task_adder
+
+
+def cache_dims(config: Any) -> tuple:
+    """``(n_layers, n_kv_heads, head_dim)`` for any family's config — the
+    one place that knows gpt2 spells these ``n_layer``/``n_head`` while
+    the llama backbone spells them ``n_layers``/``n_kv_heads``.  Callers
+    allocating cache slabs must use this, not re-derive the attributes."""
+    from ..parallel.decode import _family_of
+
+    if _family_of(config) == "gpt2":
+        return config.n_layer, config.n_head, config.head_dim
+    return config.n_layers, config.n_kv_heads, config.head_dim
 
 
 def build_decode_dag(
@@ -199,10 +215,187 @@ def build_decode_dag(
     )
 
 
+def build_backbone_decode_dag(
+    config: Any,
+    batch: int = 1,
+    step_len: int = 1,
+    pos: int = 0,
+    max_len: int = 128,
+    effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
+) -> ModelDAG:
+    """Llama-backbone decode-step DAG (Llama and Mixtral configs).
+
+    Same contract as :func:`build_decode_dag`: per-layer tasks own
+    ``cache_k_{i}`` / ``cache_v_{i}`` slabs (GQA layout:
+    ``B x n_kv_heads x max_len x hd``), RoPE applied at the static step
+    position, Mixtral layers run their router + dense experts per step
+    (routing is per-token, exactly as the fused cached forward does).
+    Oracle: the family's ``forward_cached`` over the stacked cache.
+    """
+    import math as _math
+
+    from ..models import llama as _llama
+    from ..models import mixtral as _mixtral
+    from ..parallel.decode import _family_of
+
+    family = _family_of(config)
+    if family not in ("llama", "mixtral"):
+        raise ValueError(f"backbone decode DAG needs llama/mixtral, got {family}")
+    mod = _llama if family == "llama" else _mixtral
+    is_moe = family == "mixtral"
+    if pos + step_len > max_len:
+        raise ValueError(
+            f"pos {pos} + step_len {step_len} exceeds max_len {max_len}"
+        )
+    B, T, D = batch, step_len, config.d_model
+    nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    M, eps = max_len, config.rms_eps
+    n_layers = config.n_layers
+    scale = 1.0 / _math.sqrt(hd)
+
+    specs = {
+        name: jax.ShapeDtypeStruct(shape, dtype)
+        for name, (shape, dtype) in mod.param_shapes(config).items()
+    }
+    for i in range(n_layers):
+        for kind in ("k", "v"):
+            specs[f"cache_{kind}_{i}"] = jax.ShapeDtypeStruct(
+                (B, nkv, M, hd), config.dtype
+            )
+    input_spec = jax.ShapeDtypeStruct((B, T), jnp.int32)
+
+    tasks: List[Task] = []
+    out_specs: Dict[str, Any] = {}
+    add = make_task_adder(tasks, out_specs, specs, input_spec, effective_flops)
+
+    def f_embed(p, input_ids):
+        return _llama.embedding(input_ids, p["tok_emb"])
+
+    def f_layer(p, prev):
+        x = prev["x"] if isinstance(prev, dict) else prev
+        h = _llama.rms_norm(x, p["attn_norm_g"], eps)
+        q = (h @ p["wq"]).reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        k = (h @ p["wk"]).reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+        v = (h @ p["wv"]).reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+        cos_all, sin_all = _llama.rope_tables(M, hd, config.rope_theta)
+        cos, sin = cos_all[pos:pos + T], sin_all[pos:pos + T]
+        q, k = _llama.apply_rope(q, cos, sin), _llama.apply_rope(k, cos, sin)
+        k_cache = jax.lax.dynamic_update_slice(
+            p["cache_k"], k.astype(p["cache_k"].dtype), (0, 0, pos, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            p["cache_v"], v.astype(p["cache_v"].dtype), (0, 0, pos, 0)
+        )
+        att = _decode.cached_attention(
+            q, k_cache, v_cache, jnp.int32(pos), scale
+        )
+        att = att.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
+        x = x + att @ p["wo"]
+        h2 = _llama.rms_norm(x, p["ffn_norm_g"], eps)
+        if is_moe:
+            ffn = _mixtral._moe(p, h2, config)
+        else:
+            ffn = _llama.ffn_down(
+                _llama.ffn_glu(
+                    _llama.ffn_gate(h2, p["w_gate"]),
+                    _llama.ffn_up(h2, p["w_up"]),
+                ),
+                p["w_down"],
+            )
+        return {"x": x + ffn, "k_new": k, "v_new": v}
+
+    def f_head(p, prev):
+        x = prev["x"] if isinstance(prev, dict) else prev
+        x = _llama.rms_norm(x, p["final_norm_g"], eps)
+        return _llama.lm_head(x, p["lm_head"])
+
+    add("embed", f_embed, [], {"tok_emb": "tok_emb"}, 2.0 * B * T * D, "embed")
+    prev = "embed"
+    for i in range(n_layers):
+        pre = f"l{i}_"
+        alias = {
+            "attn_norm_g": pre + "attn_norm_g",
+            "wq": pre + "wq", "wk": pre + "wk", "wv": pre + "wv",
+            "wo": pre + "wo",
+            "ffn_norm_g": pre + "ffn_norm_g",
+            "cache_k": f"cache_k_{i}", "cache_v": f"cache_v_{i}",
+        }
+        if is_moe:
+            alias["router"] = pre + "router"
+            for e in range(config.n_experts):
+                for s in ("w_gate", "w_up", "w_down"):
+                    alias[f"e{e}_{s}"] = f"{pre}e{e}_{s}"
+        else:
+            for s in ("w_gate", "w_up", "w_down"):
+                alias[s] = pre + s
+        flops = (
+            2.0 * B * T * D * (nh + 2 * nkv) * hd
+            + 2.0 * 2.0 * B * nh * T * (pos + T) * hd
+            + 2.0 * B * T * nh * hd * D
+        )
+        tid = f"layer_{i}"
+        add(tid, f_layer, [prev], alias, flops, f"layer_{i}")
+        prev = tid
+    add("logits", f_head, [prev], {
+        "final_norm_g": "final_norm_g", "lm_head": "lm_head",
+    }, 2.0 * B * T * D * config.vocab_size, "head")
+
+    name = (
+        f"{family}dec_{n_layers}l_d{D}_b{B}_t{T}_pos{pos}_m{M}"
+        + ("" if config.dtype == jnp.float32
+           else f"_{jnp.dtype(config.dtype).name}")
+    )
+
+    def init_fn(key):
+        params = mod.init_params(config, key)
+        for i in range(n_layers):
+            params[f"cache_k_{i}"] = jnp.zeros((B, nkv, M, hd), config.dtype)
+            params[f"cache_v_{i}"] = jnp.zeros((B, nkv, M, hd), config.dtype)
+        return params
+
+    def reference_forward(params, input_ids):
+        cache = {
+            "k": jnp.stack(
+                [params[f"cache_k_{i}"] for i in range(n_layers)]
+            ),
+            "v": jnp.stack(
+                [params[f"cache_v_{i}"] for i in range(n_layers)]
+            ),
+        }
+        model_params = {
+            k: v for k, v in params.items() if not k.startswith("cache_")
+        }
+        logits, _ = mod.forward_cached(
+            model_params, input_ids, cache, pos, config
+        )
+        return logits
+
+    graph = TaskGraph(tasks, name=name).freeze()
+    return ModelDAG(
+        graph=graph,
+        config=config,
+        input_spec=input_spec,
+        param_specs=specs,
+        reference_forward=reference_forward,
+        init_fn=init_fn,
+    )
+
+
+def build_decode_dag_any(config: Any, **kw) -> ModelDAG:
+    """Family-dispatching decode-step DAG builder: GPT-2 configs go to
+    :func:`build_decode_dag`, Llama/Mixtral to
+    :func:`build_backbone_decode_dag`."""
+    from ..parallel.decode import _family_of
+
+    if _family_of(config) == "gpt2":
+        return build_decode_dag(config, **kw)
+    return build_backbone_decode_dag(config, **kw)
+
+
 def apply_cache_updates(
     params: Dict[str, Any],
     task_outputs: Dict[str, Any],
-    config: GPT2Config,
+    config: Any,
     pos: int,
 ) -> Dict[str, Any]:
     """Fold a run's per-layer ``k_new``/``v_new`` outputs back into the
@@ -211,9 +404,11 @@ def apply_cache_updates(
     ``task_outputs``: ``DeviceReport.task_outputs`` from
     ``execute(keep_outputs=True)`` — per-task dispatch retains every
     executed task's output, which includes each layer's update dict.
+    Works for every family (:func:`cache_dims`).
     """
+    n_layers, _, _ = cache_dims(config)
     out = dict(params)
-    for i in range(config.n_layer):
+    for i in range(n_layers):
         o = task_outputs.get(f"layer_{i}")
         if o is None:
             raise KeyError(f"layer_{i} output missing from task_outputs")

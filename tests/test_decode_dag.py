@@ -118,3 +118,61 @@ def test_decode_dag_multi_device(policy):
 def test_position_bounds_checked():
     with pytest.raises(ValueError):
         build_decode_dag(CFG, batch=1, step_len=8, pos=30, max_len=32)
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral"])
+def test_backbone_decode_dag_multistep_token_exact(family):
+    """Llama/Mixtral decode steps through the scheduler reproduce the
+    whole-program greedy tokens exactly (GQA cache layout, RoPE at the
+    step position, per-step MoE routing)."""
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_decode_dag_any,
+    )
+
+    if family == "llama":
+        from distributed_llm_scheduler_tpu.models import llama as mod
+        from distributed_llm_scheduler_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.tiny()
+    else:
+        from distributed_llm_scheduler_tpu.models import mixtral as mod
+        from distributed_llm_scheduler_tpu.models.mixtral import (
+            MixtralConfig,
+        )
+
+        cfg = MixtralConfig.tiny()
+    b, p_len, m, n_new = 2, 6, 16, 3
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (b, p_len), 0, cfg.vocab_size,
+        dtype=jnp.int32,
+    )
+    model_params = mod.init_params(cfg, jax.random.PRNGKey(0))
+    want = mod.generate(model_params, ids, cfg, max_new_tokens=n_new)
+
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    dag = build_decode_dag_any(cfg, batch=b, step_len=p_len, pos=0, max_len=m)
+    params = dag.init_params()
+    params.update(model_params)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    rep = backend.execute(dag.graph, sched, params, ids, keep_outputs=True)
+    params = apply_cache_updates(params, rep.task_outputs, cfg, pos=0)
+    tok = jnp.argmax(np.asarray(rep.output)[:, -1, :], axis=-1)
+    got = [tok]
+    for s in range(1, n_new):
+        pos = p_len + s - 1
+        ddag = build_decode_dag_any(
+            cfg, batch=b, step_len=1, pos=pos, max_len=m
+        )
+        dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
+        drep = backend.execute(
+            ddag.graph, dsched, params, tok[:, None].astype(jnp.int32),
+            keep_outputs=True,
+        )
+        params = apply_cache_updates(params, drep.task_outputs, cfg, pos=pos)
+        tok = jnp.argmax(np.asarray(drep.output)[:, -1, :], axis=-1)
+        got.append(tok)
+    np.testing.assert_array_equal(
+        np.asarray(want[:, p_len:p_len + n_new]),
+        np.asarray(jnp.stack(got, axis=1)),
+    )
