@@ -154,9 +154,92 @@ def measure_decode(
     return out
 
 
+def measure_decode_sharded(
+    config: Any = None,
+    tp: int = 2,
+    batch: int = 8,
+    prompt_len: int = 64,
+    new_tokens: int = 16,
+    reps: int = 3,
+) -> Dict[str, Any]:
+    """Tensor-parallel decode throughput over a dp=1 x tp mesh
+    (:func:`..parallel.decode.generate_sharded`).
+
+    On a real multi-chip slice this measures tp decode; on the
+    CPU-virtual mesh it is a FUNCTIONAL number (all "devices" share the
+    host), so the result carries ``platform`` and callers must not
+    compare cross-platform.  Token parity with single-device generation
+    is pinned separately (tests/test_sharded_decode.py, dryrun).
+    """
+    import jax as _jax
+
+    from ..parallel.decode import _family_of, _module_for, generate_sharded
+    from ..parallel.mesh import make_mesh
+    from ..utils.costmodel import _fence_rtt, readback_fence, time_amortized
+
+    if config is None:
+        from ..models.gpt2 import GPT2Config
+
+        config = GPT2Config.small(dtype=jnp.bfloat16)
+    if len(_jax.devices()) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices, have {len(_jax.devices())}"
+        )
+    mod = _module_for(_family_of(config))
+    params = mod.init_params(config, _jax.random.PRNGKey(0))
+    ids = _jax.random.randint(
+        _jax.random.PRNGKey(1), (batch, prompt_len), 0, config.vocab_size,
+        dtype=jnp.int32,
+    )
+    mesh = make_mesh(dp=1, tp=tp)
+
+    out = generate_sharded(params, ids, config, mesh, max_new_tokens=new_tokens)
+    readback_fence(out)
+    rtt = _fence_rtt(_jax.devices()[0])
+    wall = max(
+        time_amortized(
+            lambda: generate_sharded(
+                params, ids, config, mesh, max_new_tokens=new_tokens
+            ),
+            reps,
+            rtt,
+        ),
+        1e-9,
+    )
+    return {
+        "tp": float(tp),
+        "batch": float(batch),
+        "prompt_len": float(prompt_len),
+        "new_tokens": float(new_tokens),
+        "wall_s": wall,
+        "tok_s_end_to_end": batch * new_tokens / wall,
+        "platform": _jax.devices()[0].platform,
+        "functional_only": _jax.devices()[0].platform == "cpu",
+    }
+
+
 if __name__ == "__main__":
     import json
     import sys
+
+    if len(sys.argv) > 1 and (
+        sys.argv[1] == "--tp" or sys.argv[1].startswith("--tp=")
+    ):
+        try:
+            tp = (
+                int(sys.argv[1].split("=", 1)[1])
+                if "=" in sys.argv[1]
+                else int(sys.argv[2])
+            )
+        except (IndexError, ValueError):
+            print("usage: decode_bench [--tp N]", file=sys.stderr)
+            sys.exit(2)
+        res = measure_decode_sharded(tp=tp)
+        print(json.dumps({
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in res.items()
+        }))
+        sys.exit(0)
 
     res = measure_decode()
     print(json.dumps({k: round(v, 4) for k, v in res.items()}))

@@ -232,8 +232,6 @@ def build_backbone_decode_dag(
     (routing is per-token, exactly as the fused cached forward does).
     Oracle: the family's ``forward_cached`` over the stacked cache.
     """
-    import math as _math
-
     from ..models import llama as _llama
     from ..models import mixtral as _mixtral
     from ..parallel.decode import _family_of
@@ -251,7 +249,7 @@ def build_backbone_decode_dag(
     nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     M, eps = max_len, config.rms_eps
     n_layers = config.n_layers
-    scale = 1.0 / _math.sqrt(hd)
+    scale = 1.0 / math.sqrt(hd)
 
     specs = {
         name: jax.ShapeDtypeStruct(shape, dtype)
@@ -328,10 +326,21 @@ def build_backbone_decode_dag(
         else:
             for s in ("w_gate", "w_up", "w_down"):
                 alias[s] = pre + s
+        F = config.ffn_hidden
+        if is_moe:
+            # router + DENSE per-step expert sweep (every expert runs
+            # every token — the disclosed dense-dispatch cost)
+            ffn_flops = (
+                2.0 * B * T * D * config.n_experts
+                + config.n_experts * 3 * 2.0 * B * T * D * F
+            )
+        else:
+            ffn_flops = 3 * 2.0 * B * T * D * F  # gate, up, down matmuls
         flops = (
             2.0 * B * T * D * (nh + 2 * nkv) * hd
             + 2.0 * 2.0 * B * nh * T * (pos + T) * hd
             + 2.0 * B * T * nh * hd * D
+            + ffn_flops
         )
         tid = f"layer_{i}"
         add(tid, f_layer, [prev], alias, flops, f"layer_{i}")

@@ -198,3 +198,20 @@ def test_decode_roofline_math():
     assert roof["bound_tok_s"] == pytest.approx(4 / expect_s)
     # no published bandwidth -> no bound, not a fabricated one
     assert decode_roofline(cfg, 4, 32, "cpu") is None
+
+
+def test_decode_bench_sharded_helper_runs():
+    """tp decode throughput probe on the CPU mesh (functional numbers,
+    disclosed via functional_only)."""
+    from distributed_llm_scheduler_tpu.eval.decode_bench import (
+        measure_decode_sharded,
+    )
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    res = measure_decode_sharded(
+        GPT2Config.tiny(), tp=2, batch=2, prompt_len=8, new_tokens=4,
+        reps=2,
+    )
+    assert res["tok_s_end_to_end"] > 0
+    assert res["functional_only"] is True  # CPU mesh
+    assert res["tp"] == 2.0
