@@ -640,6 +640,12 @@ def cmd_rankcheck(args) -> int:
         reps=args.reps,
     )
     print(json.dumps(report, indent=1))
+    if report["winner_agreement"] is None:
+        # <2 surviving policies: nothing was rankable — distinct exit code
+        # so callers don't conflate it with a measured rank refutation
+        print("rankcheck: fewer than 2 policies produced complete "
+              "placements; no ranking to check", file=sys.stderr)
+        return 3
     return 0 if report["winner_agreement"] else 1
 
 
@@ -659,7 +665,7 @@ def cmd_bench(args) -> int:
     spec.loader.exec_module(mod)
     # explicit config: this process's sys.argv holds the CLI's own args
     # ('bench'), which bench.main() must not parse as a config name
-    mod.main("small")
+    mod.main(args.config)
     return 0
 
 
@@ -770,6 +776,10 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_generate)
 
     p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")
+    p.add_argument("config", nargs="?", default="small",
+                   choices=("small", "medium"),
+                   help="bench config: GPT-2 small (flagship, default) or "
+                        "medium (BASELINE config #2)")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(

@@ -75,6 +75,11 @@ class DeviceReport:
     # distinguishable in the JSON
     streamed: bool = False
     param_loads: int = 0
+    # batched transfer calls issued (<= param_loads: a task's missing
+    # params go up in one device_put) and total bytes streamed — the
+    # numerator of the host-link bandwidth bound
+    param_load_calls: int = 0
+    param_load_bytes: int = 0
     param_evictions: int = 0
     peak_param_bytes: Dict[str, int] = field(default_factory=dict)
 
@@ -98,6 +103,8 @@ class DeviceReport:
             **(
                 {
                     "param_loads": self.param_loads,
+                    "param_load_calls": self.param_load_calls,
+                    "param_load_mb": self.param_load_bytes / 1024**2,
                     "param_evictions": self.param_evictions,
                     "peak_param_gb": {
                         k: v / 1024**3
@@ -218,22 +225,48 @@ class DeviceBackend:
 
     # -- parameter streaming ----------------------------------------------
     class _ParamStreamer:
-        """On-demand parameter residency with LRU eviction under a per-node
-        HBM budget — the reference's param-cache/eviction model (reference
+        """On-demand parameter residency with eviction under a per-node HBM
+        budget — the reference's param-cache/eviction model (reference
         ``schedulers.py:404-442``) made PHYSICAL: a node whose weights
-        exceed its budget loads each param at first use and evicts the
-        least-recently-used resident to make room, so a model larger than
-        a device's HBM still executes (slower — streaming trades bandwidth
-        for capacity, exactly the constraint the scheduler's policies
-        optimize around).
+        exceed its budget loads each param at first use and evicts
+        residents to make room, so a model larger than a device's HBM
+        still executes (slower — streaming trades bandwidth for capacity,
+        exactly the constraint the scheduler's policies optimize around).
 
-        Eviction safety under async dispatch: a buffer may still feed
-        queued ops, so before deleting anything on a node we fence that
-        node's most recent output — per-device queues are FIFO, so one
-        barrier proves every prior consumer finished.
+        Designed to approach the host-link bandwidth bound (VERDICT r3
+        next #2 — the on-demand/fence-per-eviction v1 ran 284x slow,
+        RTT-latency-bound, because every eviction drained the whole device
+        queue):
+
+        * **Plan-aware prefetch**: the schedule's per-node task order is
+          known up front (``plan``), so params for the next ``lookahead``
+          tasks are loaded while current compute is in flight — loads
+          overlap compute and each other instead of serializing.
+        * **Belady eviction**: with the plan, the victim is the resident
+          param whose next use is farthest in the future (optimal for
+          misses); LRU is the planless fallback.
+        * **Batched loads**: all of a task's missing params go up in ONE
+          ``device_put`` call (one dispatch per task, not per param).
+        * **Minimal-wait deletion**: an evicted buffer may still feed
+          queued ops, so it enters a graveyard tagged with its last
+          consumer's per-node FIFO step; freeing its memory waits only on
+          that consumer's output (per-device queues are FIFO, so that one
+          wait proves every earlier consumer finished), and a fence-step
+          watermark makes waits on already-fenced steps free.  v1 instead
+          fenced the node's LATEST output before every eviction — a full
+          queue drain per load.
+
+        The ``bytes`` ledger counts resident + graveyard (memory is not
+        free until deletion), so ``peak`` stays physically honest.
         """
 
-        def __init__(self, cluster: Cluster, params: Dict[str, Any]):
+        def __init__(
+            self,
+            cluster: Cluster,
+            params: Dict[str, Any],
+            plan: Optional[Dict[str, List[Tuple[str, Tuple[str, ...]]]]] = None,
+            lookahead: int = 8,
+        ):
             self.cluster = cluster
             self.host_params = params
             self.resident: Dict[str, Dict[str, Any]] = {
@@ -247,39 +280,109 @@ class DeviceBackend:
             self.last_use: Dict[str, Dict[str, int]] = {
                 d.node_id: {} for d in cluster
             }
-            self.last_output: Dict[str, Any] = {}
+            # plan: node -> [(tid, param globals)] in dispatch order
+            self.plan = plan or {}
+            self.pos: Dict[str, int] = {n: -1 for n in self.plan}
+            # node -> param -> ascending plan positions where it is used
+            self.uses: Dict[str, Dict[str, List[int]]] = {}
+            for n, entries in self.plan.items():
+                u: Dict[str, List[int]] = {}
+                for i, (_tid, globs) in enumerate(entries):
+                    for g in globs:
+                        u.setdefault(g, []).append(i)
+                self.uses[n] = u
+            self.lookahead = lookahead
+            # eviction-safety bookkeeping (per node): monotonically
+            # increasing dispatch step, last fenced step, each param's last
+            # consumer (step, output), evicted-but-not-yet-freed buffers
+            self.node_step: Dict[str, int] = {d.node_id: 0 for d in cluster}
+            self.fenced_step: Dict[str, int] = {d.node_id: 0 for d in cluster}
+            self.last_consumer: Dict[str, Dict[str, Tuple[int, Any]]] = {
+                d.node_id: {} for d in cluster
+            }
+            self.graveyard: Dict[str, List[Tuple[int, Any, Any, int]]] = {
+                d.node_id: [] for d in cluster
+            }
             self.loads = 0
+            self.load_calls = 0
+            self.load_bytes = 0
+            # loads that stalled a task's dispatch (param not resident at
+            # get_task time) vs loads the prefetcher issued early — the
+            # stall count is what latency-bound links actually pay for
+            self.demand_misses = 0
             self.evictions = 0
             self._step = 0
 
-        def note_output(self, node_id: str, out: Any) -> None:
-            self.last_output[node_id] = out
+        def note_task(self, node_id: str, globs, out: Any) -> None:
+            """Record that a task consuming ``globs`` was dispatched with
+            output ``out`` — the eviction fence anchor for those params."""
+            self.node_step[node_id] += 1
+            s = self.node_step[node_id]
+            for g in globs:
+                self.last_consumer[node_id][g] = (s, out)
 
-        def get(self, name: str, node_id: str, pinned: set) -> Any:
-            self._step += 1
-            res = self.resident[node_id]
-            if name in res:
-                self.last_use[node_id][name] = self._step
-                return res[name]
-            need = _array_bytes(self.host_params[name])
-            fenced = False
-            while (
-                self.bytes[node_id] + need > self.budget[node_id] and res
-            ):
-                lru = self.last_use[node_id]
-                victims = [p for p in res if p not in pinned]
-                if not victims:
-                    break  # current task's own params: allow over-budget
-                victim = min(victims, key=lambda p: lru.get(p, 0))
-                if not fenced and node_id in self.last_output:
-                    jax.block_until_ready(self.last_output[node_id])
-                    fenced = True
-                freed = res.pop(victim)
-                lru.pop(victim, None)
-                self.bytes[node_id] -= _array_bytes(freed)
-                for leaf in jax.tree_util.tree_leaves(freed):
+        def _next_use(self, node_id: str, name: str) -> float:
+            import bisect
+
+            uses = self.uses.get(node_id, {}).get(name)
+            if not uses:
+                return float("inf")
+            i = bisect.bisect_right(uses, self.pos.get(node_id, -1))
+            return uses[i] if i < len(uses) else float("inf")
+
+        def _flush(self, node_id: str, need_bytes: int) -> int:
+            """Actually free graveyard memory, oldest consumer first, until
+            ``need_bytes`` freed or the graveyard empties.  Waits only when
+            an entry's consumer step is past the fence watermark — and then
+            on that specific output, not the queue tip."""
+            g = self.graveyard[node_id]
+            g.sort(key=lambda e: e[0])
+            freed = 0
+            while g and freed < need_bytes:
+                step, out, arr, nbytes = g.pop(0)
+                if step > self.fenced_step[node_id] and out is not None:
+                    jax.block_until_ready(out)
+                    self.fenced_step[node_id] = step
+                for leaf in jax.tree_util.tree_leaves(arr):
                     leaf.delete()
-                self.evictions += 1
+                self.bytes[node_id] -= nbytes
+                freed += nbytes
+            return freed
+
+        def _evict_one(
+            self, node_id: str, pinned: set, horizon: Optional[int]
+        ) -> int:
+            """Move one victim to the graveyard.  Returns its bytes, 0 when
+            nothing is evictable (only pinned residents), or -1 when the
+            best victim is needed at/before ``horizon`` (prefetch would
+            thrash — caller stops prefetching)."""
+            res = self.resident[node_id]
+            victims = [p for p in res if p not in pinned]
+            if not victims:
+                return 0
+            if node_id in self.uses:
+                victim = max(
+                    victims, key=lambda p: self._next_use(node_id, p)
+                )
+                if (
+                    horizon is not None
+                    and self._next_use(node_id, victim) <= horizon
+                ):
+                    return -1
+            else:
+                lru = self.last_use[node_id]
+                victim = min(victims, key=lambda p: lru.get(p, 0))
+            arr = res.pop(victim)
+            self.last_use[node_id].pop(victim, None)
+            step, out = self.last_consumer[node_id].pop(victim, (0, None))
+            nbytes = _array_bytes(arr)
+            # bytes stay on the ledger until _flush deletes the buffer
+            self.graveyard[node_id].append((step, out, arr, nbytes))
+            self.evictions += 1
+            return nbytes
+
+        def _load(self, node_id: str, names: List[str]) -> None:
+            """ONE batched device_put for all of ``names``."""
             dev = self.cluster[node_id].jax_device
             # bridge through numpy: on CPU platforms device_put can ALIAS
             # the host buffer, and evicting an alias would delete the
@@ -287,19 +390,97 @@ class DeviceBackend:
             # device copy to own fresh memory, so delete() is always safe
             import numpy as _np
 
-            host = self.host_params[name]
-            arr = jax.tree_util.tree_map(
-                lambda leaf: jax.device_put(_np.asarray(leaf), dev), host
-            )
-            res[name] = arr
-            # ledger from the PLACED bytes (dtype canonicalization can make
-            # them differ from the host estimate; an asymmetric ledger
-            # would drift and shrink the effective budget)
-            self.bytes[node_id] += _array_bytes(arr)
+            hosts = [
+                jax.tree_util.tree_map(
+                    lambda leaf: _np.asarray(leaf), self.host_params[n]
+                )
+                for n in names
+            ]
+            arrs = jax.device_put(hosts, dev)
+            self.load_calls += 1
+            for n, a in zip(names, arrs):
+                self.resident[node_id][n] = a
+                # ledger from the PLACED bytes (dtype canonicalization can
+                # make them differ from the host estimate; an asymmetric
+                # ledger would drift and shrink the effective budget)
+                nb = _array_bytes(a)
+                self.bytes[node_id] += nb
+                self.load_bytes += nb
+                self.loads += 1
+                self.last_use[node_id][n] = self._step
             self.peak[node_id] = max(self.peak[node_id], self.bytes[node_id])
-            self.last_use[node_id][name] = self._step
-            self.loads += 1
-            return arr
+
+        def _ensure(
+            self,
+            node_id: str,
+            names: List[str],
+            pinned: set,
+            horizon: Optional[int] = None,
+        ) -> bool:
+            """Make ``names`` resident, evicting/freeing as needed.  Returns
+            False when stopped by the prefetch ``horizon`` (resident set is
+            already needed sooner than the prefetch target)."""
+            missing = [
+                n for n in names if n not in self.resident[node_id]
+            ]
+            if not missing:
+                return True
+            need = sum(
+                _array_bytes(self.host_params[n]) for n in missing
+            )
+            budget = self.budget[node_id]
+            while self.bytes[node_id] + need > budget:
+                deficit = self.bytes[node_id] + need - budget
+                if self.graveyard[node_id]:
+                    self._flush(node_id, deficit)
+                    continue
+                r = self._evict_one(node_id, pinned, horizon)
+                if r == -1:
+                    return False
+                if r == 0:
+                    if horizon is not None:
+                        # prefetch must NEVER overshoot the budget: the
+                        # over-budget escape exists for a task's own pinned
+                        # params only (it cannot run without them); a
+                        # speculative load has no such excuse
+                        return False
+                    break  # only the task's own params: allow over-budget
+            self._load(node_id, missing)
+            return True
+
+        def get_task(self, tid: str, node_id: str, param_items) -> Dict[str, Any]:
+            """Resident params for ``tid`` (loc -> array), then prefetch the
+            next ``lookahead`` planned tasks' params into the budget."""
+            self._step += 1
+            items = tuple(param_items)
+            names = [g for _, g in items]
+            entries = self.plan.get(node_id)
+            if entries is not None:
+                # advance the plan cursor to this task; tasks skipped at
+                # dispatch (failed upstreams) fall out of the walk
+                i = self.pos[node_id] + 1
+                while i < len(entries) and entries[i][0] != tid:
+                    i += 1
+                if i < len(entries):
+                    self.pos[node_id] = i
+            pinned = set(names)
+            self.demand_misses += sum(
+                1 for n in names if n not in self.resident[node_id]
+            )
+            self._ensure(node_id, names, pinned)
+            for n in names:
+                self.last_use[node_id][n] = self._step
+            out = {loc: self.resident[node_id][g] for loc, g in items}
+            if entries is not None:
+                p = self.pos[node_id]
+                stop = min(p + 1 + self.lookahead, len(entries))
+                for j in range(p + 1, stop):
+                    _t, globs = entries[j]
+                    if not self._ensure(
+                        node_id, list(globs), pinned | set(globs), horizon=j
+                    ):
+                        break
+            return out
 
     # -- compilation -------------------------------------------------------
     def _jitted(self, graph: TaskGraph, tid: str):
@@ -652,11 +833,7 @@ class DeviceBackend:
                 # param loads: a skipped task must not evict live params)
 
             if streamer is not None:
-                pinned = {glob for _, glob in task.param_items()}
-                pd = {
-                    loc: streamer.get(glob, node_id, pinned)
-                    for loc, glob in task.param_items()
-                }
+                pd = streamer.get_task(tid, node_id, task.param_items())
             else:
                 pd = {
                     loc: placed_params[(glob, node_id)]
@@ -689,7 +866,9 @@ class DeviceBackend:
                 out = fn(pd, *args)
             outputs[tid] = out
             if streamer is not None:
-                streamer.note_output(node_id, out)
+                streamer.note_task(
+                    node_id, [g for _, g in task.param_items()], out
+                )
 
         # fence ALL dispatched work (not just the topologically-last task:
         # multi-leaf graphs and skipped tails would otherwise under-measure).
@@ -727,6 +906,7 @@ class DeviceBackend:
         ext_outputs: Optional[Dict[str, Any]] = None,
         keep_outputs: bool = False,
         stream_params: bool = False,
+        stream_lookahead: int = 8,
         reps: int = 1,
         rebatch: bool = True,
     ) -> DeviceReport:
@@ -761,13 +941,16 @@ class DeviceBackend:
         activations held.
 
         ``stream_params=True`` replaces up-front param placement with
-        on-demand loading + LRU eviction under each node's
-        ``total_memory`` budget (:class:`_ParamStreamer`) — a node whose
-        assigned weights exceed its HBM budget still executes, trading
-        host-link bandwidth for capacity (the reference's param-cache
-        eviction made physical).  Per-task dispatch only (segments fuse
-        the load points away); the report carries
-        ``param_loads``/``param_evictions``/``peak_param_bytes``.
+        planned streaming under each node's ``total_memory`` budget
+        (:class:`_ParamStreamer`): batched loads prefetched
+        ``stream_lookahead`` tasks ahead of the dispatch cursor, Belady
+        (farthest-next-use) eviction, and minimal-wait deletion — a node
+        whose assigned weights exceed its HBM budget still executes,
+        trading host-link bandwidth for capacity (the reference's
+        param-cache eviction made physical) while loads overlap compute.
+        Per-task dispatch only (segments fuse the load points away); the
+        report carries ``param_loads``/``param_load_calls``/
+        ``param_load_bytes``/``param_evictions``/``peak_param_bytes``.
 
         ``profile=True`` records per-task wall times via per-task
         ``block_until_ready`` (Gantt charts / diagnostics).  CAVEAT: on the
@@ -815,6 +998,17 @@ class DeviceBackend:
             raise ValueError(f"params missing for placement: {missing[:5]}")
         if stream_params:
             placed, bytes_per_node = {}, {d.node_id: 0 for d in self.cluster}
+            # per-node dispatch plan for the streamer's prefetch + Belady
+            # eviction: the schedule fixes each node's task order, so the
+            # streamer knows exactly which params are needed next
+            stream_plan: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+            for tid in self.dispatch_order(graph, schedule):
+                node = schedule.placement.get(tid)
+                if node is None:
+                    continue
+                stream_plan.setdefault(node, []).append(
+                    (tid, tuple(g for _, g in graph[tid].param_items()))
+                )
         else:
             placed, bytes_per_node = self.place_params(graph, schedule, params)
 
@@ -827,7 +1021,10 @@ class DeviceBackend:
                 graph, schedule, placed, graph_input, segments=segments,
                 ext_outputs=ext_outputs,
                 streamer=(
-                    self._ParamStreamer(self.cluster, params)
+                    self._ParamStreamer(
+                        self.cluster, params, plan=stream_plan,
+                        lookahead=stream_lookahead,
+                    )
                     if stream_params else None
                 ),
                 rebatch=rebatch,
@@ -842,7 +1039,10 @@ class DeviceBackend:
         rtt = _fence_rtt(self._fence_device())
 
         streamer = (
-            self._ParamStreamer(self.cluster, params)
+            self._ParamStreamer(
+                self.cluster, params, plan=stream_plan,
+                lookahead=stream_lookahead,
+            )
             if stream_params else None
         )
         t0 = time.perf_counter()
@@ -891,6 +1091,8 @@ class DeviceBackend:
             task_outputs=touts if keep_outputs else {},
             streamed=streamer is not None,
             param_loads=streamer.loads if streamer else 0,
+            param_load_calls=streamer.load_calls if streamer else 0,
+            param_load_bytes=streamer.load_bytes if streamer else 0,
             param_evictions=streamer.evictions if streamer else 0,
             peak_param_bytes=dict(streamer.peak) if streamer else {},
         )

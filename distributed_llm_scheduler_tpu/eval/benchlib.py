@@ -341,10 +341,15 @@ def oracle_close(
     if dtype_name == "float32":
         return bool(np.allclose(a, b, rtol=2e-4, atol=2e-4))
     tol = 5e-2
-    viol_frac = float((np.abs(a - b) > (tol + tol * np.abs(a))).mean())
+    n_viol = int((np.abs(a - b) > (tol + tol * np.abs(a))).sum())
+    # allow max(1, frac*N) violating elements: a pure fraction bound
+    # degenerates to strict allclose for outputs under ~1/frac elements
+    # (ADVICE r3) — yet the measured rounding tail is a small absolute
+    # COUNT of outliers, present at any output size
+    n_allowed = max(1, int(max_violation_frac * a.size))
     denom = float(np.linalg.norm(a.ravel()))
     rel_fro = float(np.linalg.norm((a - b).ravel())) / max(denom, 1e-12)
-    return bool(viol_frac <= max_violation_frac and rel_fro <= max_rel_fro)
+    return bool(n_viol <= n_allowed and rel_fro <= max_rel_fro)
 
 
 def graph_flops(graph) -> float:

@@ -146,10 +146,13 @@ def run_rank_check(
     pred_order = sorted(per_policy, key=lambda p: per_policy[p]["predicted_s"])
     meas_order = sorted(per_policy, key=lambda p: per_policy[p]["measured_s"])
     tau = kendall_tau(pred_order, meas_order)
-    winner_ok = False
+    # <2 surviving policies: there is no ranking to refute OR confirm —
+    # report winner_agreement=None so the caller can distinguish "nothing
+    # was measurable" from an actual rank refutation (ADVICE r3)
+    winner_ok: Optional[bool] = None if len(per_policy) < 2 else False
     prediction_spread = None
     prediction_is_tie = False
-    if pred_order:
+    if pred_order and winner_ok is not None:
         preds = [per_policy[p]["predicted_s"] for p in pred_order]
         prediction_spread = preds[-1] / preds[0] if preds[0] > 0 else None
         prediction_is_tie = (
