@@ -404,7 +404,32 @@ def measure(
     log(f"bench: best={best_name} ({best*1e3:.3f} ms) vs roundrobin "
         f"({rr*1e3:.3f} ms) -> {result.vs_baseline:.3f}x; "
         f"total bench {time.time()-t_start:.1f}s")
-    print(json.dumps(result.to_json()))
+    out = result.to_json()
+    # outage-proofing (VERDICT r3 next #1): a fresh on-TPU measurement
+    # snapshots its line; a degraded run (cached/derived/CPU costs) carries
+    # the last measured line forward with a staleness stamp instead of
+    # erasing the measured record from the artifact trail
+    from distributed_llm_scheduler_tpu.eval.benchlib import (
+        load_measured_snapshot,
+        save_measured_snapshot,
+    )
+
+    fresh_tpu = platform == "tpu" and not result.fallback and oracle_ok
+    if fresh_tpu:
+        try:
+            save_measured_snapshot(out, result.model_tag)
+            log("bench: snapshotted fresh TPU measurement")
+        except Exception as e:
+            log(f"bench: WARNING could not snapshot measurement: {e}")
+    elif result.fallback:
+        snap = load_measured_snapshot(result.model_tag)
+        if snap is not None:
+            out["last_measured"] = snap
+            log(f"bench: carrying forward last measured TPU line from "
+                f"{snap['measured_at']} ({snap['age_days']} days old)")
+        else:
+            log("bench: no prior measured snapshot to carry forward")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

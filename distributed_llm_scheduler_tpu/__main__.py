@@ -624,20 +624,50 @@ def cmd_rankcheck(args) -> int:
     each placement on the live devices, report rank agreement as JSON."""
     from .eval.rankcheck import run_rank_check
 
-    cfg = _config_from(args)
-    dag = cfg.build_graph()  # applies --fuse / --quantize per RunConfig
-    if not hasattr(dag, "graph"):
-        print("rankcheck needs a model DAG (gpt2* / llama* / mixtral*); "
-              "synthetic graphs have no fns", file=sys.stderr)
-        return 2
+    kwargs = {}
+    if args.stress:
+        # the separating configuration (VERDICT r3 next #3): transfer-bound
+        # by construction, so the sim claims a winner and the check bites
+        import jax
+
+        from .core.cluster import Cluster
+        from .frontend.stress_dag import build_transfer_stress_dag
+
+        if len(jax.devices()) < 4:
+            # fewer devices collapse the regime back into a tie (1 device:
+            # no cross edges at all; 2-3 divide the 6 chains, so
+            # round-robin accidentally gets perfect chain locality) — a
+            # vacuous pass here would defeat the flag's whole point
+            print("rankcheck --stress needs >= 4 devices (run under "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+                  file=sys.stderr)
+            return 2
+        dag = build_transfer_stress_dag(chains=6, length=6, edge_mb=8.0)
+        kwargs["cluster"] = Cluster.from_jax_devices(
+            jax.devices()[:4], hbm_cap_gb=4.0
+        )
+        if args.policies is None:
+            args.policies = (
+                "roundrobin,critical,dfs,greedy,pipeline,mru,heft,pack"
+            )
+    else:
+        cfg = _config_from(args)
+        dag = cfg.build_graph()  # applies --fuse / --quantize per RunConfig
+        if not hasattr(dag, "graph"):
+            print("rankcheck needs a model DAG (gpt2* / llama* / mixtral*); "
+                  "synthetic graphs have no fns", file=sys.stderr)
+            return 2
+        kwargs["hbm_cap_gb"] = cfg.hbm_gb
+    if args.policies is None:
+        args.policies = "roundrobin,critical,pipeline,pack"
     report = run_rank_check(
         dag.graph,
         dag.init_params(),
         dag.make_inputs(),
         policies=[p.strip() for p in args.policies.split(",") if p.strip()],
-        hbm_cap_gb=cfg.hbm_gb,
         measure_repeats=args.measure_repeats,
         reps=args.reps,
+        **kwargs,
     )
     print(json.dumps(report, indent=1))
     if report["winner_agreement"] is None:
@@ -787,11 +817,20 @@ def main(argv=None) -> int:
         help="sim-vs-real policy rank agreement on live devices (JSON)",
     )
     _add_common(p)
-    p.add_argument("--policies", default="roundrobin,critical,pipeline,pack",
-                   help="comma-separated policies to rank")
+    p.add_argument("--policies", default=None,
+                   help="comma-separated policies to rank (default: "
+                        "roundrobin,critical,pipeline,pack; --stress "
+                        "defaults to all 8 distinct-tier policies)")
     p.add_argument("--measure-repeats", type=int, default=3)
     p.add_argument("--reps", type=int, default=1,
                    help="amortized repetitions per measured run")
+    p.add_argument("--stress", action="store_true",
+                   help="use the transfer-stress DAG (frontend/stress_dag): "
+                        "cheap compute, large cross-device activations — "
+                        "the regime where the sim PREDICTS separation, so "
+                        "rank agreement is asserted without the tie escape "
+                        "(ignores --model; 4 devices, 8 policies unless "
+                        "--policies given explicitly)")
     p.set_defaults(fn=cmd_rankcheck)
 
     args = ap.parse_args(argv)

@@ -420,9 +420,12 @@ class DeviceBackend:
             """Make ``names`` resident, evicting/freeing as needed.  Returns
             False when stopped by the prefetch ``horizon`` (resident set is
             already needed sooner than the prefetch target)."""
-            missing = [
+            # dedupe: a fused task can alias two local names to one global
+            # (fuse_linear_chains merges members sharing a param); loading
+            # it twice would orphan a device buffer and inflate the ledger
+            missing = list(dict.fromkeys(
                 n for n in names if n not in self.resident[node_id]
-            ]
+            ))
             if not missing:
                 return True
             need = sum(
@@ -465,7 +468,7 @@ class DeviceBackend:
                     self.pos[node_id] = i
             pinned = set(names)
             self.demand_misses += sum(
-                1 for n in names if n not in self.resident[node_id]
+                1 for n in pinned if n not in self.resident[node_id]
             )
             self._ensure(node_id, names, pinned)
             for n in names:

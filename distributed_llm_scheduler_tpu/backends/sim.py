@@ -297,6 +297,7 @@ class SimulatedBackend:
             param_load_total += load_time
 
             start = max(node_clock[node_id], host_clock)
+            inbound_xfer = 0.0
             if self.fidelity == "full":
                 # dependency wait: inputs must exist; cross-node edges pay ICI
                 for d in task.dependencies:
@@ -311,6 +312,7 @@ class SimulatedBackend:
                         )
                         dep_ready += xfer
                         transfer_total += xfer
+                        inbound_xfer += xfer
                         if self.host_synchronous_transfers:
                             # a cross-node device_put needs CONCRETE
                             # bytes: the dispatcher blocks until the
@@ -338,13 +340,24 @@ class SimulatedBackend:
                 start = max(start, heapq.heappop(slot_free))
 
             duration = task.compute_time / speeds[node_id]
+            if self.host_synchronous_transfers and self.host_slots is not None:
+                # shared-substrate fidelity: the dispatcher's synchronous
+                # memcpy runs on the same physical cores that execute
+                # compute, so inbound copy time occupies this task's slot
+                # too — without this, a transfer-heavy placement's copies
+                # hide entirely inside slot waits and the replay predicts
+                # a tie where the mesh measures a large spread (the r3
+                # rankcheck's 1.3%-predicted vs 29%-measured failure)
+                duration += inbound_xfer
             end = start + duration
             if self.host_slots is not None:
                 heapq.heappush(slot_free, end)
             node_clock[node_id] = end
             finish[tid] = end
             timings[tid] = TaskTiming(tid, node_id, start, end)
-            per_node_load[node_id] += duration
+            # load balance counts COMPUTE only (reference metric semantics);
+            # the slot-charged copy time above is occupancy, not load
+            per_node_load[node_id] += task.compute_time / speeds[node_id]
 
         makespan = max(node_clock.values()) if node_clock else 0.0
         utilization = {

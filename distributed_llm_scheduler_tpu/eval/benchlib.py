@@ -371,6 +371,65 @@ def compute_mfu(
     return flops / (makespan_s * peak)
 
 
+# -- measured-snapshot persistence ------------------------------------------
+# A tunnel outage must degrade the bench artifact to "stale-measured", not
+# erase the measured record (VERDICT r3 next #1: the r3 artifact was a
+# cached-cost replay whose policy numbers were digit-identical to r2's,
+# with every measured field silently dropped).  Fresh on-TPU runs snapshot
+# their JSON here; fallback runs carry the snapshot forward, stamped.
+
+def _snapshot_path(model_tag: str) -> str:
+    import os
+
+    return os.path.join(".costmodel", f"measured_{model_tag}.json")
+
+
+def save_measured_snapshot(result_json: Dict[str, object],
+                           model_tag: str) -> None:
+    """Persist a fresh TPU-measured bench line (with a ``measured_at``
+    UTC stamp) so later fallback runs can carry it forward."""
+    import datetime
+    import json
+    import os
+
+    os.makedirs(".costmodel", exist_ok=True)
+    with open(_snapshot_path(model_tag), "w") as f:
+        json.dump(
+            {
+                "measured_at": datetime.datetime.now(
+                    datetime.timezone.utc
+                ).isoformat(timespec="seconds"),
+                "result": result_json,
+            },
+            f,
+            indent=1,
+        )
+
+
+def load_measured_snapshot(model_tag: str) -> Optional[Dict[str, object]]:
+    """The last fresh-measured bench line for ``model_tag`` (with
+    ``measured_at`` and ``age_days``), or None."""
+    import datetime
+    import json
+    import os
+
+    path = _snapshot_path(model_tag)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+        measured_at = datetime.datetime.fromisoformat(snap["measured_at"])
+        age = datetime.datetime.now(datetime.timezone.utc) - measured_at
+        return {
+            "measured_at": snap["measured_at"],
+            "age_days": round(age.total_seconds() / 86400.0, 2),
+            "result": snap["result"],
+        }
+    except Exception:
+        return None  # a corrupt snapshot must not kill the bench
+
+
 @dataclass
 class BenchResult:
     """Everything the bench prints; ``to_json`` is THE one stdout line."""

@@ -58,7 +58,22 @@ def _fit_affine(samples: Sequence[Tuple[int, float]]) -> Tuple[float, float]:
     sxy = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
     slope = sxy / sxx if sxx > 0 else 0.0
     if slope <= 0:
-        # bandwidth unresolvable (all noise): charge latency only
+        # Noise made the fit non-monotonic (observed on the CPU mesh under
+        # concurrent load: the 4 MB sample can time faster than the 256 KB
+        # one).  An infinite bandwidth here silently zeroes every transfer
+        # charge downstream — which once flipped a rank check's predicted
+        # order run-to-run.  Degraded two-point estimate: latency from the
+        # fastest (smallest-cost) sample, bandwidth from the largest
+        # sample net of that latency — both finite, both conservative
+        # (transfers get over-charged slightly, never erased), and the
+        # latency floor survives so the caller's min-over-legs doesn't
+        # collapse to the clamp.
+        b_max, t_max = max(samples, key=lambda s: s[0])
+        lat = max(min(ys), 0.0)
+        if t_max > lat and b_max > 0:
+            return lat, (b_max / (t_max - lat)) / 1024**3
+        if t_max > 0 and b_max > 0:
+            return 0.0, (b_max / t_max) / 1024**3
         return max(my, 0.0), float("inf")
     lat = max(my - slope * mx, 0.0)
     gbps = (1.0 / slope) / 1024**3

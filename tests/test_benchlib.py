@@ -422,3 +422,26 @@ def test_oracle_close_bf16_rejects_systematic_error():
     assert not oracle_close(a, a * 1.1, "bfloat16")  # 10% scale error
     assert not oracle_close(a, np.roll(a, 1), "bfloat16")  # scrambled
     assert not oracle_close(a, a.reshape(-1, 1), "bfloat16")  # shape
+
+
+def test_measured_snapshot_roundtrip(tmp_path, monkeypatch):
+    """Fresh-TPU bench lines persist and come back stamped with age; a
+    corrupt snapshot degrades to None instead of raising."""
+    from distributed_llm_scheduler_tpu.eval.benchlib import (
+        load_measured_snapshot,
+        save_measured_snapshot,
+    )
+
+    monkeypatch.chdir(tmp_path)
+    assert load_measured_snapshot("gpt2s") is None
+    line = {"metric": "m", "value": 12.3, "mfu_segmented": 0.49}
+    save_measured_snapshot(line, "gpt2s")
+    snap = load_measured_snapshot("gpt2s")
+    assert snap["result"] == line
+    assert snap["age_days"] >= 0
+    assert "T" in snap["measured_at"]
+    # model tags are independent namespaces
+    assert load_measured_snapshot("gpt2m") is None
+    # corruption degrades gracefully
+    (tmp_path / ".costmodel" / "measured_gpt2s.json").write_text("{nope")
+    assert load_measured_snapshot("gpt2s") is None

@@ -243,3 +243,18 @@ def test_prefetch_never_overshoots_budget():
     # must refuse (evicting 'a' is forbidden, overshooting is worse)
     assert set(st.resident[node]) == {"a"}
     assert st.peak[node] <= int(budget_gb * 1024**3)
+
+
+def test_duplicate_global_loads_once():
+    """A fused task can alias two local names to one global param; the
+    streamer must load it once and ledger it once (a double load would
+    orphan a device buffer and inflate the budget forever)."""
+    import numpy as np
+
+    params = {"w": np.ones((64, 64), np.float32)}
+    seq = [("t0", ("w", "w"))]
+    st, node = _mk_streamer(params, 1.0, seq, lookahead=0)
+    pd = st.get_task("t0", node, [("a", "w"), ("b", "w")])
+    assert pd["a"] is pd["b"]
+    assert st.loads == 1
+    assert st.bytes[node] == params["w"].nbytes
