@@ -39,6 +39,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "quarters param bytes for placement, loads, and HBM")
     p.add_argument("--train-step", action="store_true",
                    help="schedule one fwd+bwd+optimizer step (gpt2* models)")
+    p.add_argument("--routed", action="store_true",
+                   help="mixtral*: expert tasks compute capacity-buffer "
+                        "sparse dispatch (top_k/E of the dense FLOPs) "
+                        "instead of dense every-expert-sees-every-token")
+    p.add_argument("--capacity-factor", type=float, default=2.0,
+                   dest="capacity_factor",
+                   help="routed capacity slack (x k*N/E tokens per expert; "
+                        "over-capacity assignments drop)")
     p.add_argument("--num-layers", type=int, default=None)
     p.add_argument("--num-nodes", type=int, default=8)
     p.add_argument("--slices", type=int, default=1,

@@ -193,6 +193,99 @@ def _moe(block_params: Dict[str, jax.Array], x: jax.Array,
     return moe_combine(w, *outs)
 
 
+# -- routed dispatch primitives ----------------------------------------------
+# The ONE implementation of the capacity-buffer routing math, shared by the
+# whole-program path (moe_routed), the EP-sharded path
+# (parallel/expert.moe_routed_stacked), and the task-graph frontend
+# (frontend/moe_dag routed tasks) — three consumers, one source of truth,
+# so a change to capacity/position/tie-breaking semantics cannot silently
+# break the oracle equivalences the tests pin.
+
+
+def moe_capacity(N: int, E: int, k: int, capacity_factor: float) -> int:
+    """Static per-expert capacity: ``ceil(k*N/E * cf)`` clamped to [1, N]."""
+    return min(N, max(1, math.ceil(k * N / E * capacity_factor)))
+
+
+def route_topk(
+    xf: jax.Array, w_router: jax.Array, k: int, C: int, out_dtype
+) -> Dict[str, jax.Array]:
+    """Static-shape top-k routing metadata over flat tokens ``xf (N, D)``.
+
+    Returns ``{top_w (N, k), flat_e (N*k,), pos (N*k,), keep (N*k,)}``:
+    renormalized gate weights, expert id per (token, slot) assignment,
+    position within the expert's arrival order (clamped to C-1 when
+    dropped), and the under-capacity mask.
+    """
+    E = w_router.shape[-1]
+    logits = (xf @ w_router).astype(jnp.float32)  # (N, E)
+    top_vals, top_idx = jax.lax.top_k(logits, k)  # (N, k)
+    top_w = jax.nn.softmax(top_vals, axis=-1).astype(out_dtype)
+
+    flat_e = top_idx.reshape(-1)  # (N*k,) expert per assignment
+    # position of each assignment within its expert's arrival order
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)  # (N*k, E)
+    pos_all = jnp.cumsum(onehot, axis=0) - 1
+    mypos = jnp.take_along_axis(pos_all, flat_e[:, None], axis=1)[:, 0]
+    keep = mypos < C
+    return {
+        "top_w": top_w,
+        "flat_e": flat_e,
+        "pos": jnp.where(keep, mypos, C - 1),
+        "keep": keep,
+    }
+
+
+def routed_dispatch(
+    xf: jax.Array, route: Dict[str, jax.Array], E: int, C: int
+) -> jax.Array:
+    """Scatter kept assignments into the global ``(E, C, D)`` buffer."""
+    N, D = xf.shape
+    k = route["top_w"].shape[-1]
+    tok_idx = jnp.repeat(jnp.arange(N), k)
+    contrib = jnp.where(route["keep"][:, None], xf[tok_idx], 0)
+    return jnp.zeros((E, C, D), xf.dtype).at[
+        route["flat_e"], route["pos"]
+    ].add(contrib)
+
+
+def routed_expert_buffer(
+    xf: jax.Array, route: Dict[str, jax.Array], expert: int, C: int
+) -> jax.Array:
+    """ONE expert's ``(C, D)`` capacity buffer — the task-graph form,
+    where each expert task dispatches only its own tokens."""
+    N, D = xf.shape
+    k = route["top_w"].shape[-1]
+    tok_idx = jnp.repeat(jnp.arange(N), k)
+    mine = route["keep"] & (route["flat_e"] == expert)
+    contrib = jnp.where(mine[:, None], xf[tok_idx], 0)
+    return jnp.zeros((C, D), xf.dtype).at[route["pos"]].add(contrib)
+
+
+def routed_collect(
+    out_buf: jax.Array, route: Dict[str, jax.Array], N: int
+) -> jax.Array:
+    """Gather expert outputs ``(E, C, D)`` back to tokens ``(N, D)``,
+    weighted by the renormalized gates; dropped assignments contribute 0."""
+    D = out_buf.shape[-1]
+    k = route["top_w"].shape[-1]
+    gathered = out_buf[route["flat_e"], route["pos"]]  # (N*k, D)
+    gathered = jnp.where(route["keep"][:, None], gathered, 0)
+    tok_idx = jnp.repeat(jnp.arange(N), k)
+    w_flat = route["top_w"].reshape(-1, 1)
+    return jnp.zeros((N, D), out_buf.dtype).at[tok_idx].add(
+        gathered * w_flat
+    )
+
+
+def route_stats(route: Dict[str, jax.Array], C: int) -> Dict[str, Any]:
+    return {
+        "capacity": C,
+        "dropped_slots": jnp.sum(~route["keep"]),
+        "total_slots": route["flat_e"].shape[0],
+    }
+
+
 def moe_routed(
     block_params: Dict[str, jax.Array],
     x: jax.Array,
@@ -219,24 +312,11 @@ def moe_routed(
     B, T, D = x.shape
     E, k = config.n_experts, config.top_k
     N = B * T
-    C = min(N, max(1, math.ceil(k * N / E * capacity_factor)))
+    C = moe_capacity(N, E, k, capacity_factor)
     xf = x.reshape(N, D)
 
-    logits = (xf @ block_params["router"]).astype(jnp.float32)  # (N, E)
-    top_vals, top_idx = jax.lax.top_k(logits, k)  # (N, k)
-    top_w = jax.nn.softmax(top_vals, axis=-1).astype(x.dtype)  # (N, k)
-
-    flat_e = top_idx.reshape(-1)  # (N*k,) expert per assignment
-    # position of each assignment within its expert's arrival order
-    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)  # (N*k, E)
-    pos_all = jnp.cumsum(onehot, axis=0) - 1
-    mypos = jnp.take_along_axis(pos_all, flat_e[:, None], axis=1)[:, 0]
-    keep = mypos < C
-    safe_pos = jnp.where(keep, mypos, C - 1)
-
-    tok_idx = jnp.repeat(jnp.arange(N), k)  # (N*k,)
-    contrib = jnp.where(keep[:, None], xf[tok_idx], 0)
-    buf = jnp.zeros((E, C, D), x.dtype).at[flat_e, safe_pos].add(contrib)
+    route = route_topk(xf, block_params["router"], k, C, x.dtype)
+    buf = routed_dispatch(xf, route, E, C)
 
     wg = jnp.stack([block_params[f"e{e}_w_gate"] for e in range(E)])
     wu = jnp.stack([block_params[f"e{e}_w_up"] for e in range(E)])
@@ -246,19 +326,9 @@ def moe_routed(
     )
     out_buf = jnp.einsum("ecf,efd->ecd", h, wd)  # (E, C, D)
 
-    gathered = out_buf[flat_e, safe_pos]  # (N*k, D)
-    gathered = jnp.where(keep[:, None], gathered, 0)
-    w_flat = top_w.reshape(-1, 1)
-    out = (
-        jnp.zeros((N, D), x.dtype).at[tok_idx].add(gathered * w_flat)
-    ).reshape(B, T, D)
+    out = routed_collect(out_buf, route, N).reshape(B, T, D)
     if with_stats:
-        stats = {
-            "capacity": C,
-            "dropped_slots": jnp.sum(~keep),
-            "total_slots": N * k,
-        }
-        return out, stats
+        return out, route_stats(route, C)
     return out
 
 

@@ -111,25 +111,122 @@ def moe_block_stacked(
     return _moe_stacked({k: params[p + k] for k in keys}, x, config)
 
 
+def moe_routed_stacked(
+    block_params: Dict[str, Any],
+    x: jax.Array,
+    config: MixtralConfig,
+    capacity_factor: float = 2.0,
+    mesh: Optional[Mesh] = None,
+    with_stats: bool = False,
+):
+    """Routed (capacity-buffer) MoE over STACKED expert weights, sharded
+    over the ``ep`` axis (VERDICT r3 next #4 — composing
+    :func:`..models.mixtral.moe_routed`'s sparse dispatch with expert
+    parallelism, so the top_k/E FLOP saving survives exactly where expert
+    placement matters).
+
+    TPU-idiomatic formulation: the computation is written in the GLOBAL
+    view — tokens scatter-add into an ``(E, C, D)`` capacity buffer,
+    experts run as one batched einsum, outputs gather back — and
+    ``with_sharding_constraint`` pins the buffer's expert dim to ``ep``
+    and the token dims to ``dp``.  The token exchange between dp-sharded
+    activations and ep-sharded buffers IS the all-to-all; XLA derives the
+    collective from the constraint pair rather than us hand-writing it
+    (the scaling-book recipe: annotate, let GSPMD insert collectives).
+    ``mesh=None`` skips constraints (single-device tests).
+
+    Routing math is :mod:`..models.mixtral`'s shared primitives
+    (``route_topk`` / ``routed_dispatch`` / ``routed_collect``) — one
+    source of truth across the whole-program, EP, and task-graph paths.
+    """
+    B, T, D = x.shape
+    E, k = config.n_experts, config.top_k
+    N = B * T
+    C = mixtral.moe_capacity(N, E, k, capacity_factor)
+    xf = x.reshape(N, D)
+
+    route = mixtral.route_topk(xf, block_params["router"], k, C, x.dtype)
+    buf = mixtral.routed_dispatch(xf, route, E, C)
+    if mesh is not None:
+        buf = jax.lax.with_sharding_constraint(
+            buf, NamedSharding(mesh, P("ep", None, None))
+        )
+
+    gate, up, down = (
+        block_params["moe_gate"], block_params["moe_up"],
+        block_params["moe_down"],
+    )
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", buf, gate)) * jnp.einsum(
+        "ecd,edf->ecf", buf, up
+    )
+    out_buf = jnp.einsum("ecf,efd->ecd", h, down)  # (E, C, D)
+    if mesh is not None:
+        out_buf = jax.lax.with_sharding_constraint(
+            out_buf, NamedSharding(mesh, P("ep", None, None))
+        )
+
+    out = mixtral.routed_collect(out_buf, route, N).reshape(B, T, D)
+    if mesh is not None:
+        out = jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, P("dp", None, None))
+        )
+    if with_stats:
+        return out, mixtral.route_stats(route, C)
+    return out
+
+
 _EP_BLOCK_KEYS = (
     "attn_norm_g", "wq", "wk", "wv", "wo", "ffn_norm_g", "router",
     "moe_gate", "moe_up", "moe_down",
 )
 
 
+def _make_ep_block(
+    config: MixtralConfig,
+    routed: bool = False,
+    capacity_factor: float = 2.0,
+    mesh: Optional[Mesh] = None,
+    stats_sink: Optional[list] = None,
+) -> Callable[[Dict[str, Any], jax.Array], jax.Array]:
+    """One EP layer over unprefixed params — the rematerialization unit.
+    ``routed=True`` swaps dense dispatch for the capacity-buffer sparse
+    dispatch (:func:`moe_routed_stacked`).  ``stats_sink`` (routed only):
+    a list the block appends each layer's drop stats to at trace time —
+    the ONE block body serves both the plain and the stats-collecting
+    forward, so they cannot drift."""
+
+    def block(block_params: Dict[str, Any], x: jax.Array) -> jax.Array:
+        h = mixtral.rms_norm(x, block_params["attn_norm_g"], config.rms_eps)
+        h = mixtral.gqa_attention(
+            h, block_params["wq"], block_params["wk"], block_params["wv"],
+            block_params["wo"], config.n_heads, config.n_kv_heads,
+            config.rope_theta,
+        )
+        x2 = mixtral.residual_add(x, h)
+        h = mixtral.rms_norm(x2, block_params["ffn_norm_g"], config.rms_eps)
+        if routed:
+            if stats_sink is not None:
+                moe, st = moe_routed_stacked(
+                    block_params, h, config, capacity_factor, mesh=mesh,
+                    with_stats=True,
+                )
+                stats_sink.append(st)
+            else:
+                moe = moe_routed_stacked(
+                    block_params, h, config, capacity_factor, mesh=mesh
+                )
+        else:
+            moe = _moe_stacked(block_params, h, config)
+        return mixtral.residual_add(x2, moe)
+
+    return block
+
+
 def _ep_block(
     block_params: Dict[str, Any], x: jax.Array, config: MixtralConfig
 ) -> jax.Array:
-    """One EP layer (unprefixed params) — the rematerialization unit."""
-    h = mixtral.rms_norm(x, block_params["attn_norm_g"], config.rms_eps)
-    h = mixtral.gqa_attention(
-        h, block_params["wq"], block_params["wk"], block_params["wv"],
-        block_params["wo"], config.n_heads, config.n_kv_heads,
-        config.rope_theta,
-    )
-    x = mixtral.residual_add(x, h)
-    h = mixtral.rms_norm(x, block_params["ffn_norm_g"], config.rms_eps)
-    return mixtral.residual_add(x, _moe_stacked(block_params, h, config))
+    """Dense EP layer (kept as the named entry point for existing callers)."""
+    return _make_ep_block(config)(block_params, x)
 
 
 def forward_ep(
@@ -137,24 +234,66 @@ def forward_ep(
     input_ids: jax.Array,
     config: MixtralConfig,
     remat: bool = False,
+    routed: bool = False,
+    capacity_factor: float = 2.0,
+    mesh: Optional[Mesh] = None,
+    _stats_sink: Optional[list] = None,
 ) -> jax.Array:
     """Mixtral forward over stacked expert params (the EP train/eval path).
 
     Shares :func:`..models.mixtral.forward_with_block`'s skeleton; only
     the layer block differs in layout.  ``remat=True`` checkpoints each
     layer — especially valuable under EP, where the dense-dispatch expert
-    activations ``(E, B, T, ffn)`` dominate HBM.
+    activations ``(E, B, T, ffn)`` dominate HBM.  ``routed=True`` uses
+    capacity-buffer sparse dispatch (top_k/E of the dense FLOPs, plus
+    capacity slack; see :func:`moe_routed_stacked`).
     """
+    if _stats_sink is not None and remat:
+        # jax.checkpoint replays the block; trace-time appends would double
+        raise ValueError("stats collection is incompatible with remat")
+    block = _make_ep_block(config, routed, capacity_factor, mesh, _stats_sink)
     return mixtral.forward_with_block(
-        params, input_ids, config, _ep_block, _EP_BLOCK_KEYS, remat=remat
+        params, input_ids, config,
+        lambda bp, x, cfg: block(bp, x), _EP_BLOCK_KEYS, remat=remat,
     )
 
 
 def loss_fn_ep(params, input_ids, targets, config: MixtralConfig,
-               remat: bool = False):
+               remat: bool = False, routed: bool = False,
+               capacity_factor: float = 2.0, mesh: Optional[Mesh] = None):
     return mixtral.nll_loss(
-        forward_ep(params, input_ids, config, remat=remat), targets
+        forward_ep(params, input_ids, config, remat=remat, routed=routed,
+                   capacity_factor=capacity_factor, mesh=mesh), targets
     )
+
+
+def forward_ep_stats(
+    params: Dict[str, Any],
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    capacity_factor: float = 2.0,
+    mesh: Optional[Mesh] = None,
+):
+    """Routed-EP forward that also aggregates per-layer drop statistics
+    (total dropped vs total (token, slot) assignments across layers) —
+    the observability the routed trade needs to be honest about.
+    Returns ``(logits, stats)``.  Same block body as :func:`forward_ep`
+    (stats flow out through the block's sink, so the two paths cannot
+    drift)."""
+    sink: list = []
+    logits = forward_ep(
+        params, input_ids, config, routed=True,
+        capacity_factor=capacity_factor, mesh=mesh, _stats_sink=sink,
+    )
+    dropped = sum(
+        (st["dropped_slots"].astype(jnp.int32) for st in sink),
+        jnp.zeros((), jnp.int32),
+    )
+    return logits, {
+        "dropped_slots": dropped,
+        "total_slots": sum(st["total_slots"] for st in sink),
+        "capacity": sink[-1]["capacity"] if sink else None,
+    }
 
 
 # -- sharding rules ----------------------------------------------------------
@@ -187,6 +326,8 @@ def make_moe_train_step(
     optimizer: Optional[Any] = None,
     learning_rate: float = 3e-4,
     remat: bool = False,
+    routed: bool = False,
+    capacity_factor: float = 2.0,
 ) -> Tuple[Callable[..., Any], Callable[..., Any]]:
     """dp x ep sharded Mixtral training step; returns ``(step, init)``.
 
@@ -195,6 +336,9 @@ def make_moe_train_step(
     ids, targets) -> (state, loss)`` is one jitted program with donated
     state.  The mesh must define ``dp`` and ``ep`` axes (``ep`` must divide
     ``n_experts``).  ``remat=True`` checkpoints each layer.
+    ``routed=True`` trains through the capacity-buffer sparse dispatch
+    (:func:`moe_routed_stacked`) — dropped assignments get zero gradient,
+    the Switch/GShard trade.
     """
     import optax
 
@@ -220,7 +364,8 @@ def make_moe_train_step(
 
     def step_fn(state: TrainState, input_ids, targets):
         loss, grads = jax.value_and_grad(loss_fn_ep)(
-            state.params, input_ids, targets, config, remat
+            state.params, input_ids, targets, config, remat,
+            routed, capacity_factor, mesh,
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params

@@ -25,6 +25,8 @@ class RunConfig:
     quantize: str = "none"         # none | int8 (utils/quantize.py)
     num_layers: Optional[int] = None  # synthetic workloads / overrides
     train_step: bool = False       # schedule one fwd+bwd+opt step (gpt2*)
+    routed: bool = False           # mixtral*: capacity-buffer sparse MoE
+    capacity_factor: float = 2.0   # routed capacity slack (x k*N/E)
 
     # cluster
     num_nodes: int = 8
@@ -135,6 +137,13 @@ class RunConfig:
             raise ValueError(
                 f"unknown quantize mode {self.quantize!r}; choose none | int8"
             )
+        if self.routed and not self.model.startswith("mixtral"):
+            # same contract as --quantize below: silently ignoring the
+            # flag would report dense numbers as routed ones
+            raise ValueError(
+                "--routed applies to mixtral* models only (sparse expert "
+                "dispatch); other workloads have no experts"
+            )
         if self.quantize != "none" and self.train_step:
             raise ValueError(
                 "--train-step does not support --quantize (int8 weights "
@@ -159,10 +168,16 @@ class RunConfig:
                 from ..frontend.train_dag import build_gpt2_train_dag
 
                 return build_gpt2_train_dag(cfg, batch=self.batch, seq_len=seq)
+            extra = (
+                {"routed": True, "capacity_factor": self.capacity_factor}
+                if self.routed
+                else {}
+            )
             dag = builder(
                 cfg, batch=self.batch, seq_len=seq,
                 microbatches=self.microbatches,
                 vocab_shards=self.vocab_shards,
+                **extra,
             )
             if self.fuse:
                 from ..core.fusion import fuse_linear_chains

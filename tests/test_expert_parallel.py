@@ -141,3 +141,90 @@ def test_ep_remat_train_step_on_mesh(tiny):
     _, loss_p = step_p(init_p(jax.random.PRNGKey(9)), ids, targets)
     _, loss_r = step_r(init_r(jax.random.PRNGKey(9)), ids, targets)
     assert abs(float(loss_p) - float(loss_r)) < 1e-5
+
+
+# -- routed dispatch under EP (VERDICT r3 next #4) ---------------------------
+
+def _full_capacity(cfg):
+    """Capacity factor at which nothing can drop (C == N)."""
+    return cfg.n_experts / cfg.top_k
+
+
+def test_routed_ep_matches_dense_at_full_capacity(tiny):
+    """Non-dropping capacity: routed-EP forward == dense stacked forward
+    == the per-expert oracle (same math, sparse dispatch)."""
+    cfg, params, ids, _ = tiny
+    stacked = stack_expert_params(params, cfg)
+    dense = forward_ep(stacked, ids, cfg)
+    routed = forward_ep(
+        stacked, ids, cfg, routed=True, capacity_factor=_full_capacity(cfg)
+    )
+    np.testing.assert_allclose(
+        np.asarray(routed), np.asarray(dense), rtol=2e-5, atol=2e-5
+    )
+    ref = mixtral.forward(params, ids, cfg)
+    np.testing.assert_allclose(
+        np.asarray(routed), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_routed_ep_on_mesh_matches_single_device(tiny):
+    """The sharded (dp x ep) routed forward must equal the unsharded one:
+    the with_sharding_constraint pair changes layout, never math."""
+    from distributed_llm_scheduler_tpu.parallel.expert import shard_ep_params
+
+    cfg, params, ids, _ = tiny
+    stacked = stack_expert_params(params, cfg)
+    single = forward_ep(
+        stacked, ids, cfg, routed=True, capacity_factor=2.0
+    )
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "ep"))
+    sharded = shard_ep_params(mesh, stacked)
+    fn = jax.jit(
+        lambda p, i: forward_ep(
+            p, i, cfg, routed=True, capacity_factor=2.0, mesh=mesh
+        )
+    )
+    got = fn(sharded, ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(single), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_routed_ep_train_step_decreases_loss(tiny):
+    cfg, _, ids, targets = tiny
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("dp", "ep"))
+    step, init = make_moe_train_step(
+        cfg, mesh, learning_rate=1e-2, routed=True, capacity_factor=2.0
+    )
+    state = init(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(4):
+        state, loss = step(state, ids, targets)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_routed_ep_stats_surface_drops(tiny):
+    """forward_ep_stats reports drop fractions: zero at full capacity,
+    positive at a squeezing one."""
+    from distributed_llm_scheduler_tpu.parallel.expert import forward_ep_stats
+
+    cfg, params, ids, _ = tiny
+    stacked = stack_expert_params(params, cfg)
+    logits, st = forward_ep_stats(
+        stacked, ids, cfg, capacity_factor=_full_capacity(cfg)
+    )
+    assert int(st["dropped_slots"]) == 0
+    assert st["total_slots"] == cfg.n_layers * ids.size * cfg.top_k
+    # squeeze: capacity well below the average load must drop something
+    _, st2 = forward_ep_stats(stacked, ids, cfg, capacity_factor=0.5)
+    assert int(st2["dropped_slots"]) > 0
+    # and the full-capacity logits equal the dense path (sanity anchor)
+    dense = forward_ep(stacked, ids, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(dense), rtol=2e-5, atol=2e-5
+    )
