@@ -77,3 +77,53 @@ def test_activation_memory_pressure_favors_mru(tiny_train):
         s = get_scheduler(name).schedule(g, cluster)
         results[name] = len(s.completed) / len(g)
     assert results["mru"] >= max(results.values()) - 1e-9
+
+
+def test_train_dag_executes_on_placed_devices(tiny_train):
+    """The whole fwd+bwd+opt step runs through DeviceBackend on a
+    multi-device mesh with loss and updated params matching local
+    execution (VERDICT r3 next #5: config #5 on placed devices)."""
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+
+    params = tiny_train.init_params()
+    inputs = tiny_train.make_inputs()
+    cluster = Cluster.from_jax_devices(jax.devices()[:4], hbm_cap_gb=2.0)
+    local = execute_dag_locally(tiny_train, params, inputs)
+    for pol in ("mru", "heft"):
+        s = get_scheduler(pol).schedule(tiny_train.graph, cluster)
+        assert not s.failed, pol
+        rep = DeviceBackend(cluster).execute(
+            tiny_train.graph, s, params, inputs
+        )
+        assert rep.transfer_edges > 0  # the step actually spread
+        np.testing.assert_allclose(
+            float(rep.output["loss"]), float(local["loss"]), rtol=1e-5
+        )
+        for k in local["params"]:
+            np.testing.assert_allclose(
+                np.asarray(rep.output["params"][k]),
+                np.asarray(local["params"][k]),
+                rtol=2e-4, atol=2e-5, err_msg=(pol, k),
+            )
+
+
+def test_train_bench_tiny():
+    """eval/train_bench end-to-end at test scale: oracle passes, every
+    policy leg reports, winner's peak-HBM is measured."""
+    from distributed_llm_scheduler_tpu.eval.train_bench import (
+        measure_train_dag,
+    )
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        res = measure_train_dag(
+            config=GPT2Config.tiny(), batch=2, seq_len=16,
+            pressure_frac=0.5, cache_dir=td, log=lambda m: None,
+        )
+    assert res["oracle_ok"], res
+    assert res["executed_step_ms"] > 0
+    assert len(res["policies"]) >= 8
+    assert res["winner_peak_hbm_gb"] is not None
+    assert res["policies"][res["best_policy"]]["completion"] == 1.0
+    assert res["baseline_complete"] in (True, False)
