@@ -12,18 +12,23 @@ slabs are placeable parameters**:
   ``B x Hkv x max_len x hd``), so *cache residency IS the placement
   problem* — the same param-cache-locality story the reference's MRU
   policy targets, with the model's largest decode-time tensors;
-* each layer task outputs ``{"x", "k_new", "v_new"}`` — the functional
-  cache-update slices the caller applies to its cache copy (retained via
-  ``execute(keep_outputs=True).task_outputs``), so execution stays pure;
-* the step position is STATIC per graph (one compiled DAG per position
-  class).  That is a disclosed simplification: the whole-program path
-  owns the traced-position `lax.scan` generation loop; this path exists
-  so placement policies can reason about and execute inference steps.
+* each layer task outputs ``{"x", "k_new", "v_new", "pos"}`` — the
+  functional cache-update slices the caller applies to its cache copy
+  (retained via ``execute(keep_outputs=True).task_outputs``), so
+  execution stays pure;
+* the step position is a TRACED runtime input (``{"ids", "pos"}``),
+  threaded through each task's output dict: attention masks against it,
+  RoPE/wpe rows are dynamic-sliced at it, cache updates land at it.  ONE
+  graph therefore serves every position of a given ``(step_len,
+  max_len)`` class — an N-token generation compiles exactly two programs
+  (prefill + decode step), not N (VERDICT r3 next #7).  Compute per step
+  is O(max_len) regardless of position (the cache is scanned fully,
+  masked), which is also what the FLOPs fields record.
 
 All three families: :func:`build_decode_dag` (GPT-2),
 :func:`build_backbone_decode_dag` (Llama / Mixtral — GQA cache layout,
-RoPE at the static step position, MoE routing per step), and the
-dispatching :func:`build_decode_dag_any`.  Oracle: the family's
+RoPE dynamic-sliced at the traced position, MoE routing per step), and
+the dispatching :func:`build_decode_dag_any`.  Oracle: the family's
 ``forward_cached`` on the same cache (logits exact, multi-step greedy
 tokens exact — ``tests/test_decode_dag.py``).
 """
@@ -55,6 +60,48 @@ def cache_dims(config: Any) -> tuple:
     return config.n_layers, config.n_kv_heads, config.head_dim
 
 
+class DecodeDAG(ModelDAG):
+    """ModelDAG whose graph input is ``{"ids": (B, T) int32, "pos": ()
+    int32}`` — position is runtime data, so one graph serves every step
+    of its ``(step_len, max_len)`` class.  ``default_pos`` seeds
+    ``make_inputs`` (callers stepping a generation pass their own)."""
+
+    default_pos: int = 0
+
+    def make_inputs(self, key: Optional[jax.Array] = None,
+                    pos: Optional[int] = None) -> Dict[str, jax.Array]:
+        key = key if key is not None else jax.random.PRNGKey(1)
+        shape = self.input_spec["ids"].shape
+        return {
+            "ids": jax.random.randint(
+                key, shape, 0, self.config.vocab_size, dtype=jnp.int32
+            ),
+            "pos": jnp.asarray(
+                self.default_pos if pos is None else pos, jnp.int32
+            ),
+        }
+
+
+def decode_inputs(
+    ids: jax.Array, pos, max_len: Optional[int] = None
+) -> Dict[str, jax.Array]:
+    """The decode graphs' input pytree for a concrete step.
+
+    Pass ``max_len`` to get the bounds check the build-time guard can no
+    longer provide (position is runtime data): an out-of-range position
+    would otherwise CLAMP the cache write (``dynamic_update_slice``
+    semantics) and silently corrupt the last cache row.
+    """
+    ids = jnp.asarray(ids, jnp.int32)
+    if max_len is not None and not isinstance(pos, jax.core.Tracer):
+        if int(pos) + ids.shape[-1] > max_len:
+            raise ValueError(
+                f"pos {int(pos)} + step_len {ids.shape[-1]} exceeds "
+                f"max_len {max_len}"
+            )
+    return {"ids": ids, "pos": jnp.asarray(pos, jnp.int32)}
+
+
 def build_decode_dag(
     config: Optional[GPT2Config] = None,
     batch: int = 1,
@@ -63,15 +110,17 @@ def build_decode_dag(
     max_len: int = 128,
     effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
 ) -> ModelDAG:
-    """Task DAG for one cached forward step at static position ``pos``.
+    """Task DAG for one cached forward step; position is a runtime input.
 
-    ``step_len > 1`` with ``pos = 0`` is the prefill step; ``step_len = 1``
-    with ``pos > 0`` is a decode step.  Params are the model weights PLUS
-    per-layer ``cache_k_{i}`` / ``cache_v_{i}`` slabs (zeros from
-    ``init_params``; load real cache state by overwriting those entries).
-    The graph's sink is the logits task; each layer's cache-update dict
-    is retained via ``execute(keep_outputs=True).task_outputs`` — apply
-    updates with :func:`apply_cache_updates`.
+    ``step_len > 1`` is the prefill class; ``step_len = 1`` the decode
+    class — one graph per class covers every position (``pos`` here only
+    seeds ``make_inputs``' default and validates against ``max_len``).
+    Params are the model weights PLUS per-layer ``cache_k_{i}`` /
+    ``cache_v_{i}`` slabs (zeros from ``init_params``; load real cache
+    state by overwriting those entries).  The graph's sink is the logits
+    task; each layer's cache-update dict is retained via
+    ``execute(keep_outputs=True).task_outputs`` — apply updates with
+    :func:`apply_cache_updates`.
     """
     config = config or GPT2Config.tiny()
     if pos + step_len > max_len:
@@ -94,21 +143,29 @@ def build_decode_dag(
         specs[f"cache_v_{i}"] = jax.ShapeDtypeStruct(
             (B, H, M, hd), config.dtype
         )
-    input_spec = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    input_spec = {
+        "ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+        "pos": jax.ShapeDtypeStruct((), jnp.int32),
+    }
 
     tasks: List[Task] = []
     out_specs: Dict[str, Any] = {}
     add = make_task_adder(tasks, out_specs, specs, input_spec, effective_flops)
 
-    def f_embed(p, input_ids):
-        # token embedding + position rows [pos, pos+T) — static pos
-        return p["wte"][input_ids] + p["wpe"][pos:pos + T]
+    def f_embed(p, inputs):
+        # token embedding + position rows [pos, pos+T) — traced pos
+        pos_t = inputs["pos"]
+        wpe_rows = jax.lax.dynamic_slice(
+            p["wpe"], (pos_t, jnp.int32(0)), (T, D)
+        )
+        return {"x": p["wte"][inputs["ids"]] + wpe_rows, "pos": pos_t}
 
     def f_layer(p, prev):
         """One cached transformer layer: attention over [0, pos+T) of the
         cache (this step's keys/values included), then the MLP.  Returns
-        the residual stream plus this step's cache-update slices."""
-        x = prev["x"] if isinstance(prev, dict) else prev
+        the residual stream, this step's cache-update slices, and the
+        threaded position."""
+        x, pos_t = prev["x"], prev["pos"]
         ln1 = gpt2.layer_norm(x, p["ln1_g"], p["ln1_b"], eps)
         qkv = ln1 @ p["qkv_w"] + p["qkv_b"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -118,14 +175,14 @@ def build_decode_dag(
 
         q, k, v = heads(q), heads(k), heads(v)
         k_cache = jax.lax.dynamic_update_slice(
-            p["cache_k"], k.astype(p["cache_k"].dtype), (0, 0, pos, 0)
+            p["cache_k"], k.astype(p["cache_k"].dtype),
+            (jnp.int32(0), jnp.int32(0), pos_t, jnp.int32(0)),
         )
         v_cache = jax.lax.dynamic_update_slice(
-            p["cache_v"], v.astype(p["cache_v"].dtype), (0, 0, pos, 0)
+            p["cache_v"], v.astype(p["cache_v"].dtype),
+            (jnp.int32(0), jnp.int32(0), pos_t, jnp.int32(0)),
         )
-        att = _decode.cached_attention(
-            q, k_cache, v_cache, jnp.int32(pos), scale
-        )
+        att = _decode.cached_attention(q, k_cache, v_cache, pos_t, scale)
         att = att.transpose(0, 2, 1, 3).reshape(B, T, D)
         x = x + (att @ p["attn_proj_w"] + p["attn_proj_b"])
         ln2 = gpt2.layer_norm(x, p["ln2_g"], p["ln2_b"], eps)
@@ -135,11 +192,10 @@ def build_decode_dag(
             ),
             p["mlp_proj_w"], p["mlp_proj_b"],
         )
-        return {"x": x + h, "k_new": k, "v_new": v}
+        return {"x": x + h, "k_new": k, "v_new": v, "pos": pos_t}
 
     def f_head(p, prev):
-        x = prev["x"] if isinstance(prev, dict) else prev
-        x = gpt2.layer_norm(x, p["ln_f_g"], p["ln_f_b"], eps)
+        x = gpt2.layer_norm(prev["x"], p["ln_f_g"], p["ln_f_b"], eps)
         return gpt2.output_projection(x, p["wte"])
 
     add("embed", f_embed, [], {"wte": "wte", "wpe": "wpe"},
@@ -158,10 +214,11 @@ def build_decode_dag(
             "mlp_proj_b": pre + "mlp_proj_b",
             "cache_k": f"cache_k_{i}", "cache_v": f"cache_v_{i}",
         }
-        # FLOPs: projections on T tokens + attention over the pos+T rows
+        # FLOPs: projections on T tokens + attention over the FULL masked
+        # cache (compute is O(M) at any position — static shapes)
         flops = (
             2.0 * B * T * D * 3 * D
-            + 2.0 * 2.0 * B * H * T * (pos + T) * hd
+            + 2.0 * 2.0 * B * H * T * M * hd
             + 2.0 * B * T * D * D
             + 2.0 * B * T * D * 4 * D * 2
         )
@@ -173,7 +230,7 @@ def build_decode_dag(
     }, 2.0 * B * T * D * config.vocab_size, "head")
 
     name = (
-        f"gpt2dec_{config.n_layer}l_d{D}_b{B}_t{T}_pos{pos}_m{M}"
+        f"gpt2dec_{config.n_layer}l_d{D}_b{B}_t{T}_m{M}"
         + ("" if config.dtype == jnp.float32
            else f"_{jnp.dtype(config.dtype).name}")
     )
@@ -185,7 +242,7 @@ def build_decode_dag(
             params[f"cache_v_{i}"] = jnp.zeros((B, H, M, hd), config.dtype)
         return params
 
-    def reference_forward(params, input_ids):
+    def reference_forward(params, inputs):
         """Whole-program oracle over the same cache params: stacked-layer
         cache assembled from the per-layer slabs, models/decode math."""
         cache = {
@@ -200,12 +257,12 @@ def build_decode_dag(
             k: v for k, v in params.items() if not k.startswith("cache_")
         }
         logits, _ = gpt2.forward_cached(
-            model_params, input_ids, cache, pos, config
+            model_params, inputs["ids"], cache, inputs["pos"], config
         )
         return logits
 
     graph = TaskGraph(tasks, name=name).freeze()
-    return ModelDAG(
+    dag = DecodeDAG(
         graph=graph,
         config=config,
         input_spec=input_spec,
@@ -213,6 +270,8 @@ def build_decode_dag(
         reference_forward=reference_forward,
         init_fn=init_fn,
     )
+    dag.default_pos = pos
+    return dag
 
 
 def build_backbone_decode_dag(
@@ -227,10 +286,11 @@ def build_backbone_decode_dag(
 
     Same contract as :func:`build_decode_dag`: per-layer tasks own
     ``cache_k_{i}`` / ``cache_v_{i}`` slabs (GQA layout:
-    ``B x n_kv_heads x max_len x hd``), RoPE applied at the static step
-    position, Mixtral layers run their router + dense experts per step
-    (routing is per-token, exactly as the fused cached forward does).
-    Oracle: the family's ``forward_cached`` over the stacked cache.
+    ``B x n_kv_heads x max_len x hd``), RoPE dynamic-sliced at the traced
+    step position, Mixtral layers run their router + dense experts per
+    step (routing is per-token, exactly as the fused cached forward
+    does).  Oracle: the family's ``forward_cached`` over the stacked
+    cache.
     """
     from ..models import llama as _llama
     from ..models import mixtral as _mixtral
@@ -260,33 +320,40 @@ def build_backbone_decode_dag(
             specs[f"cache_{kind}_{i}"] = jax.ShapeDtypeStruct(
                 (B, nkv, M, hd), config.dtype
             )
-    input_spec = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    input_spec = {
+        "ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+        "pos": jax.ShapeDtypeStruct((), jnp.int32),
+    }
 
     tasks: List[Task] = []
     out_specs: Dict[str, Any] = {}
     add = make_task_adder(tasks, out_specs, specs, input_spec, effective_flops)
 
-    def f_embed(p, input_ids):
-        return _llama.embedding(input_ids, p["tok_emb"])
+    def f_embed(p, inputs):
+        return {
+            "x": _llama.embedding(inputs["ids"], p["tok_emb"]),
+            "pos": inputs["pos"],
+        }
 
     def f_layer(p, prev):
-        x = prev["x"] if isinstance(prev, dict) else prev
+        x, pos_t = prev["x"], prev["pos"]
         h = _llama.rms_norm(x, p["attn_norm_g"], eps)
         q = (h @ p["wq"]).reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
         k = (h @ p["wk"]).reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
         v = (h @ p["wv"]).reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
         cos_all, sin_all = _llama.rope_tables(M, hd, config.rope_theta)
-        cos, sin = cos_all[pos:pos + T], sin_all[pos:pos + T]
+        cos = jax.lax.dynamic_slice(cos_all, (pos_t, 0), (T, hd // 2))
+        sin = jax.lax.dynamic_slice(sin_all, (pos_t, 0), (T, hd // 2))
         q, k = _llama.apply_rope(q, cos, sin), _llama.apply_rope(k, cos, sin)
         k_cache = jax.lax.dynamic_update_slice(
-            p["cache_k"], k.astype(p["cache_k"].dtype), (0, 0, pos, 0)
+            p["cache_k"], k.astype(p["cache_k"].dtype),
+            (jnp.int32(0), jnp.int32(0), pos_t, jnp.int32(0)),
         )
         v_cache = jax.lax.dynamic_update_slice(
-            p["cache_v"], v.astype(p["cache_v"].dtype), (0, 0, pos, 0)
+            p["cache_v"], v.astype(p["cache_v"].dtype),
+            (jnp.int32(0), jnp.int32(0), pos_t, jnp.int32(0)),
         )
-        att = _decode.cached_attention(
-            q, k_cache, v_cache, jnp.int32(pos), scale
-        )
+        att = _decode.cached_attention(q, k_cache, v_cache, pos_t, scale)
         att = att.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
         x = x + att @ p["wo"]
         h2 = _llama.rms_norm(x, p["ffn_norm_g"], eps)
@@ -300,11 +367,10 @@ def build_backbone_decode_dag(
                 ),
                 p["w_down"],
             )
-        return {"x": x + ffn, "k_new": k, "v_new": v}
+        return {"x": x + ffn, "k_new": k, "v_new": v, "pos": pos_t}
 
     def f_head(p, prev):
-        x = prev["x"] if isinstance(prev, dict) else prev
-        x = _llama.rms_norm(x, p["final_norm_g"], eps)
+        x = _llama.rms_norm(prev["x"], p["final_norm_g"], eps)
         return _llama.lm_head(x, p["lm_head"])
 
     add("embed", f_embed, [], {"tok_emb": "tok_emb"}, 2.0 * B * T * D, "embed")
@@ -338,7 +404,7 @@ def build_backbone_decode_dag(
             ffn_flops = 3 * 2.0 * B * T * D * F  # gate, up, down matmuls
         flops = (
             2.0 * B * T * D * (nh + 2 * nkv) * hd
-            + 2.0 * 2.0 * B * nh * T * (pos + T) * hd
+            + 2.0 * 2.0 * B * nh * T * M * hd  # full masked cache, O(M)
             + 2.0 * B * T * nh * hd * D
             + ffn_flops
         )
@@ -350,7 +416,7 @@ def build_backbone_decode_dag(
     }, 2.0 * B * T * D * config.vocab_size, "head")
 
     name = (
-        f"{family}dec_{n_layers}l_d{D}_b{B}_t{T}_pos{pos}_m{M}"
+        f"{family}dec_{n_layers}l_d{D}_b{B}_t{T}_m{M}"
         + ("" if config.dtype == jnp.float32
            else f"_{jnp.dtype(config.dtype).name}")
     )
@@ -362,7 +428,7 @@ def build_backbone_decode_dag(
             params[f"cache_v_{i}"] = jnp.zeros((B, nkv, M, hd), config.dtype)
         return params
 
-    def reference_forward(params, input_ids):
+    def reference_forward(params, inputs):
         cache = {
             "k": jnp.stack(
                 [params[f"cache_k_{i}"] for i in range(n_layers)]
@@ -375,12 +441,12 @@ def build_backbone_decode_dag(
             k: v for k, v in params.items() if not k.startswith("cache_")
         }
         logits, _ = mod.forward_cached(
-            model_params, input_ids, cache, pos, config
+            model_params, inputs["ids"], cache, inputs["pos"], config
         )
         return logits
 
     graph = TaskGraph(tasks, name=name).freeze()
-    return ModelDAG(
+    dag = DecodeDAG(
         graph=graph,
         config=config,
         input_spec=input_spec,
@@ -388,6 +454,8 @@ def build_backbone_decode_dag(
         reference_forward=reference_forward,
         init_fn=init_fn,
     )
+    dag.default_pos = pos
+    return dag
 
 
 def build_decode_dag_any(config: Any, **kw) -> ModelDAG:

@@ -4,7 +4,9 @@ layer sees an inference workload (VERDICT r2 missing #4).
 Pins: prefill-step DAG logits == models/decode cached forward; decode-step
 DAG at pos>0 stays exact over a multi-step loop with functional cache
 updates; cache slabs are real placeable params the scheduler accounts;
-multi-device placed execution matches.
+multi-device placed execution matches; and position is RUNTIME data —
+one decode graph serves every step, so an N-token generation compiles
+O(1) programs (VERDICT r3 next #7).
 """
 
 import jax
@@ -17,6 +19,7 @@ from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
 from distributed_llm_scheduler_tpu.frontend.decode_dag import (
     apply_cache_updates,
     build_decode_dag,
+    decode_inputs,
 )
 from distributed_llm_scheduler_tpu.models import gpt2
 from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
@@ -32,7 +35,7 @@ def _prompt():
 
 
 def test_cache_slabs_are_placeable_params():
-    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    dag = build_decode_dag(CFG, batch=B, step_len=P, max_len=M)
     g = dag.graph
     for i in range(CFG.n_layer):
         t = g[f"layer_{i}"]
@@ -44,72 +47,131 @@ def test_cache_slabs_are_placeable_params():
 
 
 def test_prefill_dag_matches_cached_forward():
-    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    dag = build_decode_dag(CFG, batch=B, step_len=P, max_len=M)
     params = dag.init_params()
-    ids = _prompt()
+    inputs = decode_inputs(_prompt(), 0)
     cluster = Cluster.from_jax_devices(jax.devices()[:1])
     backend = DeviceBackend(cluster)
     sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    rep = backend.execute(dag.graph, sched, params, ids)
-    want = dag.reference_forward(params, ids)
+    rep = backend.execute(dag.graph, sched, params, inputs)
+    want = dag.reference_forward(params, inputs)
     np.testing.assert_allclose(
         np.asarray(want), np.asarray(rep.output), rtol=2e-5, atol=2e-5
     )
 
 
+def _run_generation(n_new, backend, cluster, model_params, ids, max_len):
+    """Prefill DAG + ONE reused decode DAG over n_new greedy tokens."""
+    dag = build_decode_dag(CFG, batch=B, step_len=P, max_len=max_len)
+    params = dag.init_params()
+    params.update(model_params)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    rep = backend.execute(
+        dag.graph, sched, params, decode_inputs(ids, 0), keep_outputs=True
+    )
+    params = apply_cache_updates(params, rep.task_outputs, CFG, pos=0)
+    tok = jnp.argmax(np.asarray(rep.output)[:, -1, :], axis=-1)
+    got = [tok]
+
+    # ONE decode graph + ONE schedule reused for every position
+    ddag = build_decode_dag(CFG, batch=B, step_len=1, max_len=max_len)
+    dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
+    for s in range(1, n_new):
+        pos = P + s - 1
+        drep = backend.execute(
+            ddag.graph, dsched, params,
+            decode_inputs(tok[:, None], pos), keep_outputs=True,
+        )
+        params = apply_cache_updates(params, drep.task_outputs, CFG, pos=pos)
+        tok = jnp.argmax(np.asarray(drep.output)[:, -1, :], axis=-1)
+        got.append(tok)
+    return jnp.stack(got, axis=1)
+
+
 def test_multistep_decode_loop_token_exact():
-    """Prefill DAG + per-token decode DAGs with functional cache updates
+    """Prefill DAG + a reused decode DAG with functional cache updates
     must reproduce models/decode.generate greedy tokens exactly."""
     ids = _prompt()
     model_params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
     n_new = 3
     want = gpt2.generate(model_params, ids, CFG, max_new_tokens=n_new)
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    got = _run_generation(n_new, backend, cluster, model_params, ids, M)
+    np.testing.assert_array_equal(np.asarray(want[:, P:P + n_new]),
+                                  np.asarray(got))
 
+
+def test_long_generation_compiles_constant_graphs():
+    """32+ new tokens: position is runtime data, so after the first decode
+    step NO new jitted callables appear — the whole generation runs on
+    two compiled programs' worth of task fns (prefill + decode classes).
+    VERDICT r3 next #7 asked for <= 4 graphs over >= 32 tokens; the
+    traced-position design gives exactly 2."""
+    ids = _prompt()
+    model_params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    n_new = 32
+    max_len = P + n_new
+    want = gpt2.generate(model_params, ids, CFG, max_new_tokens=n_new)
     cluster = Cluster.from_jax_devices(jax.devices()[:1])
     backend = DeviceBackend(cluster)
 
-    # prefill at pos 0
-    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    dag = build_decode_dag(CFG, batch=B, step_len=P, max_len=max_len)
     params = dag.init_params()
     params.update(model_params)
     sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    rep = backend.execute(dag.graph, sched, params, ids, keep_outputs=True)
+    rep = backend.execute(
+        dag.graph, sched, params, decode_inputs(ids, 0), keep_outputs=True
+    )
     params = apply_cache_updates(params, rep.task_outputs, CFG, pos=0)
     tok = jnp.argmax(np.asarray(rep.output)[:, -1, :], axis=-1)
     got = [tok]
 
-    # token-by-token decode steps
+    ddag = build_decode_dag(CFG, batch=B, step_len=1, max_len=max_len)
+    dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
+    jit_cache_sizes = []
     for s in range(1, n_new):
         pos = P + s - 1
-        ddag = build_decode_dag(CFG, batch=B, step_len=1, pos=pos, max_len=M)
-        dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
         drep = backend.execute(
-            ddag.graph, dsched, params, tok[:, None].astype(jnp.int32),
-            keep_outputs=True,
+            ddag.graph, dsched, params,
+            decode_inputs(tok[:, None], pos), keep_outputs=True,
+            warmup=(s == 1),
         )
         params = apply_cache_updates(params, drep.task_outputs, CFG, pos=pos)
         tok = jnp.argmax(np.asarray(drep.output)[:, -1, :], axis=-1)
         got.append(tok)
+        jit_cache_sizes.append(len(backend._jit_cache))
+    # token-exact over the whole run
+    np.testing.assert_array_equal(
+        np.asarray(want[:, P:P + n_new]),
+        np.asarray(jnp.stack(got, axis=1)),
+    )
+    # no new jitted callables after the first decode step: steps 2..31
+    # reuse the same compiled fns, position flowing in as data
+    assert len(set(jit_cache_sizes)) == 1, jit_cache_sizes
 
-    got = jnp.stack(got, axis=1)
-    np.testing.assert_array_equal(np.asarray(want[:, P:P + n_new]),
-                                  np.asarray(got))
+
+def test_decode_inputs_shapes():
+    dag = build_decode_dag(CFG, batch=B, step_len=1, max_len=M)
+    inp = dag.make_inputs(pos=5)
+    assert inp["ids"].shape == (B, 1)
+    assert int(inp["pos"]) == 5
 
 
 @pytest.mark.parametrize("policy", ["mru", "roundrobin"])
 def test_decode_dag_multi_device(policy):
     """Placed decode step on the 8-device mesh: cache slabs distribute,
     validator passes, logits exact."""
-    dag = build_decode_dag(CFG, batch=B, step_len=P, pos=0, max_len=M)
+    dag = build_decode_dag(CFG, batch=B, step_len=P, max_len=M)
     params = dag.init_params()
-    ids = _prompt()
+    inputs = decode_inputs(_prompt(), 0)
     cluster = Cluster.from_jax_devices(hbm_cap_gb=4.0)
     sched = get_scheduler(policy).schedule(dag.graph, cluster)
     assert not sched.failed
     vrep = validate_schedule(dag.graph, cluster, sched)
     assert vrep.ok
-    rep = DeviceBackend(cluster).execute(dag.graph, sched, params, ids)
-    want = dag.reference_forward(params, ids)
+    rep = DeviceBackend(cluster).execute(dag.graph, sched, params, inputs)
+    want = dag.reference_forward(params, inputs)
     np.testing.assert_allclose(
         np.asarray(want), np.asarray(rep.output), rtol=2e-5, atol=2e-5
     )
@@ -124,7 +186,8 @@ def test_position_bounds_checked():
 def test_backbone_decode_dag_multistep_token_exact(family):
     """Llama/Mixtral decode steps through the scheduler reproduce the
     whole-program greedy tokens exactly (GQA cache layout, RoPE at the
-    step position, per-step MoE routing)."""
+    traced step position, per-step MoE routing) — with ONE decode graph
+    reused across steps."""
     from distributed_llm_scheduler_tpu.frontend.decode_dag import (
         build_decode_dag_any,
     )
@@ -151,23 +214,23 @@ def test_backbone_decode_dag_multistep_token_exact(family):
 
     cluster = Cluster.from_jax_devices(jax.devices()[:1])
     backend = DeviceBackend(cluster)
-    dag = build_decode_dag_any(cfg, batch=b, step_len=p_len, pos=0, max_len=m)
+    dag = build_decode_dag_any(cfg, batch=b, step_len=p_len, max_len=m)
     params = dag.init_params()
     params.update(model_params)
     sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    rep = backend.execute(dag.graph, sched, params, ids, keep_outputs=True)
+    rep = backend.execute(
+        dag.graph, sched, params, decode_inputs(ids, 0), keep_outputs=True
+    )
     params = apply_cache_updates(params, rep.task_outputs, cfg, pos=0)
     tok = jnp.argmax(np.asarray(rep.output)[:, -1, :], axis=-1)
     got = [tok]
+    ddag = build_decode_dag_any(cfg, batch=b, step_len=1, max_len=m)
+    dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
     for s in range(1, n_new):
         pos = p_len + s - 1
-        ddag = build_decode_dag_any(
-            cfg, batch=b, step_len=1, pos=pos, max_len=m
-        )
-        dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
         drep = backend.execute(
-            ddag.graph, dsched, params, tok[:, None].astype(jnp.int32),
-            keep_outputs=True,
+            ddag.graph, dsched, params,
+            decode_inputs(tok[:, None], pos), keep_outputs=True,
         )
         params = apply_cache_updates(params, drep.task_outputs, cfg, pos=pos)
         tok = jnp.argmax(np.asarray(drep.output)[:, -1, :], axis=-1)
@@ -176,3 +239,15 @@ def test_backbone_decode_dag_multistep_token_exact(family):
         np.asarray(want[:, p_len:p_len + n_new]),
         np.asarray(jnp.stack(got, axis=1)),
     )
+
+
+def test_decode_inputs_bounds_check():
+    """Runtime position bounds: the build-time guard can't see runtime
+    positions, so decode_inputs(max_len=...) must catch the overflow that
+    dynamic_update_slice would silently clamp."""
+    ids = jnp.zeros((1, 1), jnp.int32)
+    decode_inputs(ids, 31, max_len=32)  # fits
+    with pytest.raises(ValueError, match="exceeds"):
+        decode_inputs(ids, 32, max_len=32)
+    with pytest.raises(ValueError, match="exceeds"):
+        decode_inputs(jnp.zeros((1, 8), jnp.int32), 25, max_len=32)
