@@ -1,0 +1,60 @@
+"""Interconnect-sensitivity probe in the multi-device-bound regime
+(VERDICT r3 next #8): the sweep must RE-SCHEDULE per scale, band ties
+out of winner flips, and report both best- and any-policy movement."""
+
+from distributed_llm_scheduler_tpu.eval.ici_probe import (
+    run_probe,
+    sweep_interconnect,
+)
+
+
+def test_probe_tiny_end_to_end():
+    res = run_probe("tiny", log=lambda m: None)
+    assert res["n_tasks"] > 10
+    for tier in ("ici", "dcn"):
+        sweep = res[tier]
+        assert set(sweep["scales"]) == {"x0.25", "x1.0", "x4.0"}
+        for row in sweep["scales"].values():
+            assert row["winner"] is not None
+            assert row["best_makespan_ms"] > 0
+            assert row["winner_cross_slice_edges"] is not None
+        assert sweep["max_best_makespan_movement"] is not None
+        assert sweep["max_any_policy_movement"] is not None
+    assert set(res["conclusion"]) == {
+        "ici_moves_best_makespan_over_5pct",
+        "dcn_moves_best_makespan_over_5pct",
+        "any_winner_flip",
+    }
+
+
+def test_tie_band_suppresses_noise_flips():
+    """Two policies within 2% trading first place across scales is a tie,
+    not a flip — construct that case directly."""
+    from distributed_llm_scheduler_tpu.backends.sim import TieredLinkModel
+    from distributed_llm_scheduler_tpu.core.cluster import Cluster
+    from distributed_llm_scheduler_tpu.frontend.llama_dag import (
+        build_llama_dag,
+    )
+    from distributed_llm_scheduler_tpu.models.llama import LlamaConfig
+
+    dag = build_llama_dag(
+        LlamaConfig.tiny(), batch=4, seq_len=32, microbatches=4
+    )
+    cluster = Cluster.multislice(2, 4, dag.graph.total_param_gb())
+    out = sweep_interconnect(
+        "ici", (0.25, 1.0, 4.0), dag.graph, cluster, TieredLinkModel(),
+        policies=("roundrobin", "heft"), log=lambda m: None,
+    )
+    # whatever the winners are, a flip claim requires a >2% margin
+    if out["winner_flips"]:
+        rows = out["scales"]
+        base = rows["x1.0"]
+        changed = [
+            r for r in rows.values()
+            if r["winner"] != base["winner"]
+        ]
+        assert any(
+            r["best_makespan_ms"]
+            < r["makespans_ms"][base["winner"]] * 0.98
+            for r in changed
+        )
