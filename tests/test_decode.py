@@ -215,3 +215,25 @@ def test_decode_bench_sharded_helper_runs():
     assert res["tok_s_end_to_end"] > 0
     assert res["functional_only"] is True  # CPU mesh
     assert res["tp"] == 2.0
+
+
+def test_decode_attribution_functional():
+    """Per-component decode attribution (VERDICT r3 next #6): every
+    component reports a positive time, derived fields are consistent, and
+    byte counts are exact.  CPU = structural check; TPU gives the real
+    numbers."""
+    from distributed_llm_scheduler_tpu.eval.decode_bench import (
+        decode_attribution,
+    )
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config.tiny()
+    r = decode_attribution(cfg, batch=2, prompt_len=16, new_tokens=8, reps=2)
+    for k in ("step_ms", "forward_donated_ms", "forward_undonated_ms",
+              "head_ms", "attn_ms", "sample_ms"):
+        assert r[k] > 0, (k, r)
+    assert r["cache_copy_ms"] >= 0
+    assert r["loop_overhead_ms"] >= 0
+    assert r["head_bytes"] == cfg.n_embd * cfg.vocab_size * 4
+    assert r["family"] == "gpt2"
+    assert r["decode_tok_s"] > 0
