@@ -42,7 +42,7 @@ def measure_train_dag(
     batch: int = 8,
     seq_len: int = 512,
     hbm_gb: float = 14.0,
-    pressure_frac: float = 0.35,
+    pressure_frac: float = 0.55,
     cache_dir: str = ".costmodel",
     log=log,
 ) -> Dict[str, Any]:
@@ -51,7 +51,12 @@ def measure_train_dag(
     ``pressure_frac``: the modeled per-core budget is
     ``pressure_frac x total step footprint`` (params + peak activations),
     so placement must spread the step and eviction-aware policies have
-    something to win.
+    something to win.  The 0.55 default sits at the measured completion
+    cliff for the config-#5 scale: locality/eviction-aware policies
+    (mru/greedy/heft) place 100% while critical/dfs/roundrobin drop
+    tasks and the group-packing policies fail outright — the reference's
+    completion-rate-under-constraint story, reproduced on the training
+    workload.
     """
     from .. import Cluster, DeviceState, get_scheduler, validate_schedule
     from ..backends.device import DeviceBackend
