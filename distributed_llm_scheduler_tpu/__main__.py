@@ -201,10 +201,6 @@ def cmd_execute(args) -> int:
         print("--trace needs per-task timings; add --profile",
               file=sys.stderr)
         return 2
-    if args.stream_params and args.segments:
-        print("--stream-params needs per-task dispatch; drop --segments",
-              file=sys.stderr)
-        return 2
     if cfg.slices > 1:
         # live clusters carry their REAL slice topology (from_jax_devices
         # reads device.slice_index); an artificial --slices would silently
@@ -759,9 +755,11 @@ def main(argv=None) -> int:
                         "a Chrome/Perfetto trace JSON to this path")
     p.add_argument("--stream-params", action="store_true",
                    dest="stream_params",
-                   help="load params on demand with LRU eviction under "
-                        "each node's HBM budget — executes models whose "
-                        "weights exceed the budget (bandwidth for capacity)")
+                   help="planned param streaming (prefetch + Belady "
+                        "eviction) under each node's HBM budget — executes "
+                        "models whose weights exceed the budget (bandwidth "
+                        "for capacity); composes with --segments (one "
+                        "batched load per fused program)")
     p.add_argument("--inject-failure", default=None, metavar="NODE[:FRAC]",
                    dest="inject_failure",
                    help="fault injection: kill NODE (id or index) after "

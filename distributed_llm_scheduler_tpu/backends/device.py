@@ -514,6 +514,9 @@ class DeviceBackend:
         ext_outputs: Optional[Dict[str, Any]] = None,
         streamer: Optional["DeviceBackend._ParamStreamer"] = None,
         rebatch: bool = True,
+        segments_pre: Optional[
+            List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]
+        ] = None,
     ) -> float:
         """Compile every (fn, placement-device) combination ahead of time;
         returns seconds.
@@ -526,7 +529,7 @@ class DeviceBackend:
         if segments:
             self._run_segmented(
                 graph, schedule, placed_params, graph_input, ext_outputs,
-                rebatch=rebatch,
+                rebatch=rebatch, streamer=streamer, segments_pre=segments_pre,
             )
         else:
             self._run(
@@ -604,7 +607,10 @@ class DeviceBackend:
     # -- segment fusion ----------------------------------------------------
     @staticmethod
     def build_segments(
-        graph: TaskGraph, schedule: Schedule, order: List[str]
+        graph: TaskGraph,
+        schedule: Schedule,
+        order: List[str],
+        max_union_gb: Optional[Dict[str, float]] = None,
     ) -> List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]:
         """Partition the dispatch order into per-device segments.
 
@@ -623,17 +629,50 @@ class DeviceBackend:
         Returns (node_id, tids, exports): ``exports`` are the tasks whose
         outputs are consumed by later segments or by nobody (leaves —
         kept for the end-of-run fence and the final output).
+
+        ``max_union_gb`` (budget-aware segmentation, for segment-granular
+        parameter streaming): a per-node cap on a segment's param-global
+        union — a run splits when adding a task would push its union past
+        the cap, so each fused program's weights fit the streaming budget
+        and eviction happens between segments.  A single task whose own
+        params exceed the cap still gets a (over-budget) segment — the
+        same escape as the streamer's pinned-params rule.  Without the
+        cap, one device's whole run is one segment and an oversubscribed
+        model's union could never fit.
         """
         placement = schedule.placement
         runs: List[Tuple[str, List[str]]] = []
+        run_union: Dict[str, float] = {}  # current run's param GB by name
+
+        def param_gb_of(tid: str) -> Dict[str, float]:
+            # authoritative graph-wide sizes: a task may list a param
+            # without declaring bytes (falls back per the Task contract),
+            # and per-task dicts could otherwise overwrite a declared
+            # size with a smaller one
+            return {
+                g: graph.param_size_gb(g)
+                for _, g in graph[tid].param_items()
+            }
+
         for tid in order:
             if tid not in placement:
                 continue
             node = placement[tid]
-            if runs and runs[-1][0] == node:
+            same_node = bool(runs) and runs[-1][0] == node
+            if same_node and max_union_gb and node in max_union_gb:
+                grown = dict(run_union)
+                grown.update(param_gb_of(tid))
+                if (
+                    sum(grown.values()) > max_union_gb[node]
+                    and run_union  # never split an empty run
+                ):
+                    same_node = False  # budget split
+            if same_node:
                 runs[-1][1].append(tid)
+                run_union.update(param_gb_of(tid))
             else:
                 runs.append((node, [tid]))
+                run_union = param_gb_of(tid)
         consumers: Dict[str, set] = {tid: set() for tid in placement}
         for seg_i, (_, tids) in enumerate(runs):
             for tid in tids:
@@ -717,6 +756,37 @@ class DeviceBackend:
         per_graph[key] = fn
         return fn
 
+    # fraction of a node's streaming budget one segment's param union may
+    # occupy: 0.5 leaves room for the NEXT segment's union to prefetch
+    # while the current fused program runs (double buffering)
+    STREAM_SEGMENT_FRAC = 0.5
+
+    def _stream_segment_caps(self) -> Dict[str, float]:
+        return {
+            d.node_id: d.total_memory * self.STREAM_SEGMENT_FRAC
+            for d in self.cluster
+        }
+
+    @staticmethod
+    def segment_stream_plan(
+        graph: TaskGraph,
+        segments: List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]],
+    ) -> Dict[str, List[Tuple[str, Tuple[str, ...]]]]:
+        """Per-node streamer plan at SEGMENT granularity: each entry is
+        (synthetic segment id, the segment's param-global union).  The
+        streamer's plan interface is unit-agnostic, so the same prefetch +
+        Belady machinery that serves per-task streaming serves segments —
+        one batched load per segment, next segment prefetched while the
+        current fused program runs."""
+        plan: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+        for i, (node, tids, _exports) in enumerate(segments):
+            seen: Dict[str, None] = {}
+            for tid in tids:
+                for _, g in graph[tid].param_items():
+                    seen.setdefault(g)
+            plan.setdefault(node, []).append((f"__seg{i}", tuple(seen)))
+        return plan
+
     def _run_segmented(
         self,
         graph: TaskGraph,
@@ -726,14 +796,29 @@ class DeviceBackend:
         ext_outputs: Optional[Dict[str, Any]] = None,
         fence: bool = True,
         rebatch: bool = True,
-    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int]:
+        streamer: Optional["DeviceBackend._ParamStreamer"] = None,
+        segments_pre: Optional[
+            List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]
+        ] = None,
+    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any]]:
         """Segment-fused execution: same placement, one launch per segment.
         Tasks with failed upstreams are dropped at segment-build time (host
         side), preserving fail-and-continue.  Cross-segment inputs are
         deduplicated per segment — a remote value consumed by several tasks
         of one segment transfers once, so transfer counts can be LOWER than
         per-task dispatch (an inherent win of batching, reported as
-        measured)."""
+        measured).
+
+        ``streamer``: segment-granular parameter streaming (oversubscribed
+        models at fused dispatch speed): runs are budget-split so each
+        segment's param union fits ``STREAM_SEGMENT_FRAC`` of the node's
+        budget (leaving room to prefetch the NEXT segment's union while
+        the current program runs — double buffering), each union loads as
+        one batched transfer, and eviction fences anchor on segment
+        outputs.  The streamer must have been built with
+        :meth:`segment_stream_plan` over the same budget-split segments
+        (``execute`` guarantees this; a drop-filter divergence only costs
+        prefetch accuracy, never correctness)."""
         placement = schedule.placement
         order = self.dispatch_order(graph, schedule)
         # drop tasks whose (transitive) producers are unplaced/skipped —
@@ -745,22 +830,36 @@ class DeviceBackend:
             if all(d in alive for d in aids):
                 alive.add(tid)
         order = [t for t in order if t in alive and t not in (ext_outputs or ())]
-        segments = self.build_segments(graph, schedule, order)
+        # caller-precomputed segments (execute builds them once for the
+        # streamer plan, the warmup, and every timed rep — a rebuild here
+        # would land inside the makespan window).  Only reusable when no
+        # task was drop-filtered: the precomputation ran unfiltered.
+        segments = None
+        if segments_pre is not None:
+            if sum(len(t) for _n, t, _e in segments_pre) == len(order):
+                segments = segments_pre
+        if segments is None:
+            segments = self.build_segments(
+                graph, schedule, order,
+                max_union_gb=(
+                    self._stream_segment_caps() if streamer else None
+                ),
+            )
 
         outputs: Dict[str, Any] = dict(ext_outputs or {})
         transfer_edges = 0
         transfer_bytes = 0
-        for node, tids, exports in segments:
+        for seg_i, (node, tids, exports) in enumerate(segments):
             dev = self.cluster[node].jax_device
             union: Dict[str, Any] = {}
             ext: Dict[str, Any] = {}
             inside = set(tids)
             needs_input = False
+            union_names: Dict[str, None] = {}
             for tid in tids:
                 task = graph[tid]
                 for _, g in task.param_items():
-                    if g not in union:
-                        union[g] = placed_params[(g, node)]
+                    union_names.setdefault(g)
                 aids = task.arg_tasks or task.dependencies
                 if not aids:
                     needs_input = True
@@ -772,10 +871,24 @@ class DeviceBackend:
                             transfer_bytes += _array_bytes(x)
                             x = jax.device_put(x, dev)
                         ext[d] = x
+            if streamer is not None:
+                union = streamer.get_task(
+                    f"__seg{seg_i}", node,
+                    [(g, g) for g in union_names],
+                )
+            else:
+                union = {
+                    g: placed_params[(g, node)] for g in union_names
+                }
             if needs_input:
                 ext["__input__"] = jax.device_put(graph_input, dev)
             fn = self._segment_callable(graph, tids, exports, rebatch)
-            outputs.update(fn(union, ext))
+            seg_out = fn(union, ext)
+            outputs.update(seg_out)
+            if streamer is not None and exports:
+                streamer.note_task(
+                    node, list(union_names), seg_out[exports[-1]]
+                )
 
         n_fences = 0
         last_on_device: Dict[str, Any] = {}
@@ -946,13 +1059,18 @@ class DeviceBackend:
         ``stream_params=True`` replaces up-front param placement with
         planned streaming under each node's ``total_memory`` budget
         (:class:`_ParamStreamer`): batched loads prefetched
-        ``stream_lookahead`` tasks ahead of the dispatch cursor, Belady
+        ``stream_lookahead`` units ahead of the dispatch cursor, Belady
         (farthest-next-use) eviction, and minimal-wait deletion — a node
         whose assigned weights exceed its HBM budget still executes,
         trading host-link bandwidth for capacity (the reference's
         param-cache eviction made physical) while loads overlap compute.
-        Per-task dispatch only (segments fuse the load points away); the
-        report carries ``param_loads``/``param_load_calls``/
+        Composes with ``segments=True``: the streaming unit becomes the
+        SEGMENT (one batched load per fused program's param union, next
+        segment prefetched while the current one runs), so oversubscribed
+        models run at fused dispatch granularity; a segment whose union
+        alone exceeds the budget runs over-budget with the peak recorded
+        (same escape as a single task's pinned params).  The report
+        carries ``param_loads``/``param_load_calls``/
         ``param_load_bytes``/``param_evictions``/``peak_param_bytes``.
 
         ``profile=True`` records per-task wall times via per-task
@@ -975,12 +1093,6 @@ class DeviceBackend:
             raise ValueError(
                 "profile=True needs per-task dispatch; run without segments"
             )
-        if segments and stream_params:
-            raise ValueError(
-                "stream_params needs per-task dispatch (segment fusion "
-                "compiles the per-param load points away); run without "
-                "segments"
-            )
         if reps < 1:
             raise ValueError(f"reps must be >= 1, got {reps}")
         if reps > 1 and (profile or stream_params):
@@ -999,19 +1111,31 @@ class DeviceBackend:
         missing = sorted(graph.unique_params() - set(params))
         if missing:
             raise ValueError(f"params missing for placement: {missing[:5]}")
+        segments_pre = None
         if stream_params:
             placed, bytes_per_node = {}, {d.node_id: 0 for d in self.cluster}
             # per-node dispatch plan for the streamer's prefetch + Belady
             # eviction: the schedule fixes each node's task order, so the
-            # streamer knows exactly which params are needed next
-            stream_plan: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
-            for tid in self.dispatch_order(graph, schedule):
-                node = schedule.placement.get(tid)
-                if node is None:
-                    continue
-                stream_plan.setdefault(node, []).append(
-                    (tid, tuple(g for _, g in graph[tid].param_items()))
+            # streamer knows exactly which params are needed next.  Under
+            # segment fusion the streaming unit is the SEGMENT (one
+            # batched load per fused program, next segment prefetched
+            # while the current one runs)
+            if segments:
+                segments_pre = self.build_segments(
+                    graph, schedule,
+                    self.dispatch_order(graph, schedule),
+                    max_union_gb=self._stream_segment_caps(),
                 )
+                stream_plan = self.segment_stream_plan(graph, segments_pre)
+            else:
+                stream_plan = {}
+                for tid in self.dispatch_order(graph, schedule):
+                    node = schedule.placement.get(tid)
+                    if node is None:
+                        continue
+                    stream_plan.setdefault(node, []).append(
+                        (tid, tuple(g for _, g in graph[tid].param_items()))
+                    )
         else:
             placed, bytes_per_node = self.place_params(graph, schedule, params)
 
@@ -1031,6 +1155,7 @@ class DeviceBackend:
                     if stream_params else None
                 ),
                 rebatch=rebatch,
+                segments_pre=segments_pre,
             )
 
         # fence round-trip, re-measured per execute (outside the timed
@@ -1055,7 +1180,8 @@ class DeviceBackend:
                 output, timings, tedges, tbytes, n_fences, n_disp, touts = (
                     self._run_segmented(
                         graph, schedule, placed, graph_input, ext_outputs,
-                        fence=fence, rebatch=rebatch,
+                        fence=fence, rebatch=rebatch, streamer=streamer,
+                        segments_pre=segments_pre,
                     )
                 )
             else:
