@@ -611,6 +611,7 @@ class DeviceBackend:
         schedule: Schedule,
         order: List[str],
         max_union_gb: Optional[Dict[str, float]] = None,
+        param_gb: Optional[Dict[str, float]] = None,
     ) -> List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]:
         """Partition the dispatch order into per-device segments.
 
@@ -639,40 +640,48 @@ class DeviceBackend:
         same escape as the streamer's pinned-params rule.  Without the
         cap, one device's whole run is one segment and an oversubscribed
         model's union could never fit.
+
+        ``param_gb`` overrides per-name sizes (callers with the actual
+        host arrays pass TRUE device bytes); missing names fall back to
+        the graph-wide declared sizes.
         """
         placement = schedule.placement
         runs: List[Tuple[str, List[str]]] = []
-        run_union: Dict[str, float] = {}  # current run's param GB by name
+        run_names: set = set()   # current run's param-global names
+        run_total = 0.0          # its union GB — running total, O(1)/task
+        sizes = param_gb or {}
 
-        def param_gb_of(tid: str) -> Dict[str, float]:
-            # authoritative graph-wide sizes: a task may list a param
-            # without declaring bytes (falls back per the Task contract),
-            # and per-task dicts could otherwise overwrite a declared
-            # size with a smaller one
-            return {
-                g: graph.param_size_gb(g)
-                for _, g in graph[tid].param_items()
-            }
+        def size_of(g: str) -> float:
+            # caller-supplied TRUE bytes when available (declared/default
+            # sizes can under-count and defeat the split); graph-wide
+            # declared sizes otherwise
+            s = sizes.get(g)
+            return s if s is not None else graph.param_size_gb(g)
 
         for tid in order:
             if tid not in placement:
                 continue
             node = placement[tid]
+            globs = list(dict.fromkeys(
+                g for _, g in graph[tid].param_items()
+            ))
             same_node = bool(runs) and runs[-1][0] == node
             if same_node and max_union_gb and node in max_union_gb:
-                grown = dict(run_union)
-                grown.update(param_gb_of(tid))
-                if (
-                    sum(grown.values()) > max_union_gb[node]
-                    and run_union  # never split an empty run
-                ):
-                    same_node = False  # budget split
+                extra = sum(
+                    size_of(g) for g in globs if g not in run_names
+                )
+                if run_total + extra > max_union_gb[node] and run_names:
+                    same_node = False  # budget split (never an empty run)
             if same_node:
                 runs[-1][1].append(tid)
-                run_union.update(param_gb_of(tid))
             else:
                 runs.append((node, [tid]))
-                run_union = param_gb_of(tid)
+                run_names = set()
+                run_total = 0.0
+            for g in globs:
+                if g not in run_names:
+                    run_names.add(g)
+                    run_total += size_of(g)
         consumers: Dict[str, set] = {tid: set() for tid in placement}
         for seg_i, (_, tids) in enumerate(runs):
             for tid in tids:
@@ -1125,6 +1134,12 @@ class DeviceBackend:
                     graph, schedule,
                     self.dispatch_order(graph, schedule),
                     max_union_gb=self._stream_segment_caps(),
+                    # size by the ACTUAL host arrays: declared/default
+                    # sizes can under-count and defeat the budget split
+                    param_gb={
+                        g: _array_bytes(params[g]) / (1024**3)
+                        for g in graph.unique_params()
+                    },
                 )
                 stream_plan = self.segment_stream_plan(graph, segments_pre)
             else:
