@@ -393,14 +393,17 @@ def cmd_train(args) -> int:
     from .parallel.mesh import factorize_mesh, make_mesh
     from .parallel.train import make_train_step
 
+    if args.model.startswith("mixtral"):
+        return _cmd_train_moe(args)
     cfg_map = {"gpt2": GPT2Config.small, "gpt2-medium": GPT2Config.medium,
                "gpt2-tiny": GPT2Config.tiny}
     if args.model not in cfg_map:
         # silently training a default GPT-2 when asked for llama would be
         # worse than refusing
-        print(f"train supports {sorted(cfg_map)} (the sharded train step "
-              "is GPT-2-family; llama/mixtral train via the task-graph "
-              "path: --train-step on schedule/execute)", file=sys.stderr)
+        print(f"train supports {sorted(cfg_map)} and mixtral* (dp x ep "
+              "expert parallelism, --routed for sparse dispatch); llama "
+              "trains via the task-graph path: --train-step on "
+              "schedule/execute", file=sys.stderr)
         return 2
     mcfg = cfg_map[args.model]()
     pp_mb = 0
@@ -442,6 +445,24 @@ def cmd_train(args) -> int:
         train_step, init_state = make_train_step(
             mcfg, mesh, remat=args.remat, scan=args.scan
         )
+    batch = max(2 * axes["dp"], 2)
+    if pp_mb:
+        batch = max(batch, pp_mb)  # each microbatch needs >= 1 sequence
+    return _run_train_loop(
+        args, train_step, init_state, batch,
+        seq=min(args.seq_len, mcfg.n_positions),
+        vocab_size=mcfg.vocab_size,
+    )
+
+
+def _run_train_loop(args, train_step, init_state, batch, seq, vocab_size):
+    """Shared train-subcommand scaffold: init (+ checkpoint resume),
+    synthetic batch, step loop, checkpoint save — one implementation for
+    the GPT-2 (dp x tp / pp) and MoE (dp x ep) paths so checkpoint
+    handling and the loss-print contract cannot diverge."""
+    import jax
+    import jax.numpy as jnp
+
     state = init_state(jax.random.PRNGKey(args.seed))
     if args.ckpt and os.path.exists(args.ckpt):
         from .utils.checkpoint import load_state
@@ -449,15 +470,11 @@ def cmd_train(args) -> int:
         state = load_state(args.ckpt, state)
         print(f"resumed from {args.ckpt} at step {int(state.step)}",
               file=sys.stderr)
-    batch = max(2 * axes["dp"], 2)
-    if pp_mb:
-        batch = max(batch, pp_mb)  # each microbatch needs >= 1 sequence
-    seq = min(args.seq_len, mcfg.n_positions)
     ids = jax.random.randint(
-        jax.random.PRNGKey(1), (batch, seq), 0, mcfg.vocab_size, dtype=jnp.int32
+        jax.random.PRNGKey(1), (batch, seq), 0, vocab_size, dtype=jnp.int32
     )
     targets = jnp.roll(ids, -1, axis=1)
-    for step in range(args.steps):
+    for _ in range(args.steps):
         state, loss = train_step(state, ids, targets)
         print(f"step {int(state.step)}: loss {float(loss):.4f}")
     if args.ckpt:
@@ -465,6 +482,56 @@ def cmd_train(args) -> int:
 
         print(f"saved {save_state(state, args.ckpt)}", file=sys.stderr)
     return 0
+
+
+def _cmd_train_moe(args) -> int:
+    """Mixtral training on a dp x ep mesh (dense or routed dispatch) —
+    the CLI face of ``parallel/expert.make_moe_train_step``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from .models.mixtral import MixtralConfig
+    from .parallel.expert import make_moe_train_step
+
+    cfg_map = {
+        "mixtral": MixtralConfig.mixtral_8x7b,
+        "mixtral-8x7b": MixtralConfig.mixtral_8x7b,
+        "mixtral-tiny": MixtralConfig.tiny,
+    }
+    if args.model not in cfg_map:
+        print(f"unknown model {args.model!r}; mixtral variants are "
+              f"{sorted(cfg_map)}", file=sys.stderr)
+        return 2
+    if args.pp or args.scan:
+        print("--pp/--scan are the GPT-2 train path's flags; the MoE "
+              "path trains dp x ep", file=sys.stderr)
+        return 2
+    mcfg = cfg_map[args.model]()
+    n_dev = len(jax.devices())
+    # widest ep that divides both the expert count and the device count;
+    # remaining devices become dp
+    ep = 1
+    for cand in range(min(mcfg.n_experts, n_dev), 0, -1):
+        if mcfg.n_experts % cand == 0 and n_dev % cand == 0:
+            ep = cand
+            break
+    dp = n_dev // ep
+    mesh = Mesh(np.array(jax.devices()[:n_dev]).reshape(dp, ep), ("dp", "ep"))
+    print(f"mesh dp={dp} x ep={ep}"
+          + (f", routed (capacity x{args.capacity_factor})"
+             if args.routed else ", dense dispatch"),
+          file=sys.stderr)
+    train_step, init_state = make_moe_train_step(
+        mcfg, mesh, remat=args.remat, routed=args.routed,
+        capacity_factor=args.capacity_factor,
+    )
+    return _run_train_loop(
+        args, train_step, init_state, batch=max(2 * dp, 2),
+        seq=min(args.seq_len, mcfg.max_seq_len),
+        vocab_size=mcfg.vocab_size,
+    )
 
 
 def cmd_generate(args) -> int:

@@ -853,6 +853,17 @@ class DeviceBackend:
                 max_union_gb=(
                     self._stream_segment_caps() if streamer else None
                 ),
+                # the drop-filter rebuild must size by true bytes too, or
+                # under-declared params defeat the budget split on exactly
+                # this path (the streamer holds the host arrays)
+                param_gb=(
+                    {
+                        g: _array_bytes(streamer.host_params[g]) / (1024**3)
+                        for g in graph.unique_params()
+                        if g in streamer.host_params
+                    }
+                    if streamer else None
+                ),
             )
 
         outputs: Dict[str, Any] = dict(ext_outputs or {})
