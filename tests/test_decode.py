@@ -229,9 +229,14 @@ def test_decode_attribution_functional():
 
     cfg = GPT2Config.tiny()
     r = decode_attribution(cfg, batch=2, prompt_len=16, new_tokens=8, reps=2)
-    for k in ("step_ms", "forward_donated_ms", "forward_undonated_ms",
+    for k in ("forward_donated_ms", "forward_undonated_ms",
               "head_ms", "attn_ms", "sample_ms"):
         assert r[k] > 0, (k, r)
+    # step_ms is DIFFERENCED (wall(N) - wall(1)) and clamps to ~0 when a
+    # loaded host times the longer run no slower than the shorter one —
+    # non-negative is the structural guarantee; positivity needs a quiet
+    # machine (the TPU artifact asserts it there)
+    assert r["step_ms"] >= 0, r
     assert r["cache_copy_ms"] >= 0
     assert r["loop_overhead_ms"] >= 0
     assert r["head_bytes"] == cfg.n_embd * cfg.vocab_size * 4
