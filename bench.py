@@ -132,10 +132,23 @@ def main(config_name: str = None) -> None:
         f32_fallback = True
 
     applied = cm.apply(graph)
+    # Disclose calibration provenance: a TPU-platform cache hit is a
+    # legitimate cost model but NOT a fresh measurement — label it so
+    # (the r3 artifact carried digit-identical r2 numbers under a "live"
+    # label).  cache_hit comes straight from calibrate_cached.
+    from distributed_llm_scheduler_tpu.utils.costmodel import cache_age_days
+
+    src = cost_suffix.lstrip("_") or "live-tpu"
+    if src == "live-tpu" and cm.cache_hit:
+        age = cache_age_days(cm.measured_at)
+        src = (
+            f"tpu-cache({age:.1f}d old)" if age is not None
+            else "tpu-cache(unstamped)"
+        )
     log(f"bench: built {graph.name}: {len(graph)} tasks, "
         f"{graph.total_param_gb():.2f} GB params")
     log(f"bench: cost model [platform={cm.platform} "
-        f"source={cost_suffix.lstrip('_') or 'live-tpu'}] "
+        f"source={src} measured_at={cm.measured_at or 'unstamped'}] "
         f"({time.time()-t0:.1f}s, {applied} tasks); per-task total "
         f"{sum(cm.task_seconds.values())*1e3:.2f} ms, critical path "
         f"{graph.critical_path_time()*1e3:.2f} ms")
@@ -143,14 +156,14 @@ def main(config_name: str = None) -> None:
     measure(
         dag, graph, params, ids, devices, platform, cost_suffix,
         f32_fallback, t_start, dispatch_s=cm.dispatch_s,
-        model_tag=model_tag,
+        model_tag=model_tag, cost_measured_at=cm.measured_at,
     )
 
 
 def measure(
     dag, graph, params, ids, devices, platform, cost_suffix,
     f32_fallback, t_start, dispatch_s: float = 0.0,
-    model_tag: str = "gpt2s",
+    model_tag: str = "gpt2s", cost_measured_at: str = "",
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -189,9 +202,12 @@ def measure(
     pt_reps, seg_reps, fused_reps = (
         (6, 16, 32) if platform == "tpu" else (2, 3, 4)
     )
-    pt_makespan = backend.execute(
-        graph, sched_one, params, ids, warmup=False, reps=pt_reps
-    ).makespan_s
+    pt_makespan = min(
+        backend.execute(
+            graph, sched_one, params, ids, warmup=False, reps=pt_reps
+        ).makespan_s
+        for _ in range(2)
+    )
     fused_fn = jax.jit(dag.reference_forward)
     fused = fused_fn(params, ids)
     # fence-amortized timing: block_until_ready is unreliable through the
@@ -219,9 +235,16 @@ def measure(
     # fused_reps (32 on TPU) ≈ a 200+ ms window on this graph: tunnel RTT
     # jitter (a few ms) drops below a few percent of the measurement; the
     # CPU fallback's fences are cheap, so 4 reps suffice there
+    # min-of-3 windows: a single amortized window still swings with
+    # window-scale tunnel/tenant throughput dips (observed 11.3 vs
+    # 18.6 ms on the segmented leg across back-to-back runs); the
+    # minimum is the device-time estimator the calibrator already uses
     fused_wall_s = max(
-        time_amortized(
-            lambda: fused_scalar(params, ids), fused_reps, rtt
+        min(
+            time_amortized(
+                lambda: fused_scalar(params, ids), fused_reps, rtt
+            )
+            for _ in range(3)
         ),
         1e-9,
     )
@@ -266,11 +289,15 @@ def measure(
         seg_oracle = oracle_close(fused, srep.output, dtype_name_oracle)
         # amortized over queued runs: the ~400 MB logits of in-flight
         # reps stay well under HBM, and the fence correction's residual
-        # error drops to sub-ms
-        seg_makespan = backend.execute(
-            graph, sched_one, params, ids, segments=True, warmup=False,
-            reps=seg_reps,
-        ).makespan_s
+        # error drops to sub-ms; min-of-3 windows nets out window-scale
+        # throughput dips (see fused_wall_s)
+        seg_makespan = min(
+            backend.execute(
+                graph, sched_one, params, ids, segments=True,
+                warmup=False, reps=seg_reps,
+            ).makespan_s
+            for _ in range(3)
+        )
         seg_mfu = compute_mfu(flops, seg_makespan, platform, dtype_name)
         log(f"bench: segment-fused single-chip makespan "
             f"{seg_makespan*1e3:.2f} ms ({srep.n_dispatches} launches vs "
@@ -405,6 +432,10 @@ def measure(
         f"({rr*1e3:.3f} ms) -> {result.vs_baseline:.3f}x; "
         f"total bench {time.time()-t_start:.1f}s")
     out = result.to_json()
+    # when the per-task calibration was actually measured (a TPU-platform
+    # run can legitimately reuse a same-round cache; the stamp keeps that
+    # distinct from a fresh measurement in the artifact itself)
+    out["cost_measured_at"] = cost_measured_at or None
     # outage-proofing (VERDICT r3 next #1): a fresh on-TPU measurement
     # snapshots its line; a degraded run (cached/derived/CPU costs) carries
     # the last measured line forward with a staleness stamp instead of

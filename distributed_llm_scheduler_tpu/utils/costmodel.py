@@ -36,6 +36,17 @@ class CostModel:
     # pre-method-field artifact (calibrate_cached refuses those: mixing
     # their semantics with current ones silently skews the replay)
     method: str = ""
+    # UTC ISO stamp of when the calibration was MEASURED ("" for artifacts
+    # predating the field).  A cache hit keeps the original stamp, so
+    # consumers can disclose calibration age instead of passing a
+    # months-old cache off as a live measurement (the r3 artifact failure
+    # mode: policy makespans digit-identical across rounds).
+    measured_at: str = ""
+    # True when this model came off disk rather than being measured in
+    # this process.  NOT persisted — provenance of the object in hand,
+    # set by calibrate_cached, so consumers label cache hits directly
+    # instead of inferring them from stamp age.
+    cache_hit: bool = False
 
     def apply(self, graph: TaskGraph) -> int:
         """Overwrite compute_time for tasks present in the model.
@@ -62,6 +73,7 @@ class CostModel:
                     "task_seconds": self.task_seconds,
                     "dispatch_s": self.dispatch_s,
                     "method": self.method,
+                    "measured_at": self.measured_at,
                 },
                 f,
                 indent=1,
@@ -75,6 +87,7 @@ class CostModel:
         return cls(
             d["graph_name"], d["platform"], d["task_seconds"],
             d.get("dispatch_s", 0.0), d.get("method", ""),
+            d.get("measured_at", ""),
         )
 
 
@@ -306,7 +319,8 @@ def calibrate(
         readback_fence(out)  # drain before the next measurement
     dispatch_s = statistics.median(dispatch_samples) if dispatch_samples else 0.0
     return CostModel(
-        graph.name, device.platform, times, dispatch_s, method="amortized"
+        graph.name, device.platform, times, dispatch_s, method="amortized",
+        measured_at=_utc_stamp(),
     )
 
 
@@ -340,7 +354,42 @@ def _calibrate_profile(
             dur = t.duration
             if tid not in best or dur < best[tid]:
                 best[tid] = dur
-    return CostModel(graph.name, device.platform, best, method="profile")
+    return CostModel(
+        graph.name, device.platform, best, method="profile",
+        measured_at=_utc_stamp(),
+    )
+
+
+def _utc_stamp() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+
+
+def cache_age_days(measured_at: str) -> Optional[float]:
+    """Days since a ``measured_at`` stamp; None if blank/unparseable."""
+    import datetime
+
+    if not measured_at:
+        return None
+    try:
+        then = datetime.datetime.fromisoformat(measured_at)
+    except ValueError:
+        return None
+    if then.tzinfo is None:  # naive stamp (hand-edited): assume UTC
+        then = then.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return (now - then).total_seconds() / 86400.0
+
+
+def recalibrate_requested() -> bool:
+    """The ``DLS_RECALIBRATE`` honesty knob: bench-level callers pass this
+    as ``refresh=`` so committed calibration caches can't masquerade as
+    live measurements across rounds.  Library callers (and tests) are NOT
+    env-sensitive — they get cache semantics unless they opt in."""
+    return os.environ.get("DLS_RECALIBRATE", "") not in ("", "0")
 
 
 def calibrate_cached(
@@ -350,17 +399,27 @@ def calibrate_cached(
     cache_dir: str = ".costmodel",
     device: Optional[Any] = None,
     repeats: int = 3,
+    refresh: bool = False,
 ) -> CostModel:
-    """Calibrate, or load a previous calibration for this graph+platform."""
+    """Calibrate, or load a previous calibration for this graph+platform.
+
+    ``refresh=True`` bypasses the cache and re-measures — the knob that
+    keeps bench artifacts honest across rounds: without it a
+    git-committed calibration makes every later "measurement" a replay
+    of the first round's numbers.  Bench-level callers wire it to
+    :func:`recalibrate_requested`; direct library/test callers keep
+    plain cache semantics.
+    """
     import jax
 
     device = device if device is not None else jax.devices()[0]
     path = os.path.join(cache_dir, f"{graph.name}_{device.platform}.json")
-    if os.path.exists(path):
+    if not refresh and os.path.exists(path):
         cm = CostModel.load(path)
         # method == "": pre-method-field artifact — its per-task semantics
         # (and missing dispatch_s) would silently mix with current ones
         if cm.method and set(cm.task_seconds) == set(graph.task_ids()):
+            cm.cache_hit = True
             return cm
     cm = calibrate(graph, params, graph_input, device=device, repeats=repeats)
     cm.save(path)

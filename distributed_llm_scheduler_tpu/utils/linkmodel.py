@@ -225,13 +225,21 @@ def calibrate_link_cached(
     cache_dir: str = ".costmodel",
     devices: Optional[Sequence[Any]] = None,
     repeats: int = 5,
+    refresh: bool = False,
 ) -> LinkCalibration:
-    """Calibrate, or load a previous calibration for this platform."""
+    """Calibrate, or load a previous calibration for this platform.
+
+    ``refresh=True`` bypasses the cache and re-measures — same honesty
+    knob as ``costmodel.calibrate_cached`` (tunnel bandwidth drifts
+    between sessions; a committed cache must not masquerade as a live
+    number).  Bench callers wire it to
+    ``costmodel.recalibrate_requested``.
+    """
     import jax
 
     devices = list(devices if devices is not None else jax.devices())
     path = os.path.join(cache_dir, f"link_{devices[0].platform}.json")
-    if os.path.exists(path):
+    if not refresh and os.path.exists(path):
         cal = LinkCalibration.load(path)
         # staleness check (cf. costmodel.calibrate_cached's task-set check):
         # a cache written in a 1-device session carries only an *estimated*

@@ -152,7 +152,8 @@ def test_choose_cost_model_stale_cache_falls_through(tmp_path, monkeypatch):
         str(tmp_path / "flagship_tpu.json")
     )
 
-    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device,
+                              refresh=False):
         return CostModel(graph.name, device.platform, {"a": 1.0, "b": 1.0})
 
     monkeypatch.setattr(
@@ -176,7 +177,8 @@ def test_choose_cost_model_derives_from_base_pair(tmp_path, monkeypatch):
         str(tmp_path / "base_tpu.json")
     )
 
-    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device,
+                              refresh=False):
         return CostModel(
             graph.name, device.platform, {"mb0_layer_0_attention": 2.0}
         )
@@ -196,7 +198,8 @@ def test_choose_cost_model_derives_from_base_pair(tmp_path, monkeypatch):
 def test_choose_cost_model_cpu_last_resort(tmp_path, monkeypatch):
     g = _graph("flagship", ["a"])
 
-    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device,
+                              refresh=False):
         return CostModel(graph.name, device.platform, {"a": 1.0})
 
     monkeypatch.setattr(
@@ -304,7 +307,8 @@ def test_choose_cost_model_rejects_pre_method_cache(tmp_path, monkeypatch):
     }  # no "method" key
     path.write_text(json.dumps(legacy))
 
-    def fake_calibrate_cached(graph, params, inp, cache_dir, device):
+    def fake_calibrate_cached(graph, params, inp, cache_dir, device,
+                              refresh=False):
         return CostModel(
             graph.name, device.platform, {"a": 1.0, "b": 1.0},
             method="profile",

@@ -190,12 +190,34 @@ def test_costmodel_roundtrip(tmp_path, tiny_dag):
         tiny_dag.graph, params, ids, cache_dir=str(tmp_path), repeats=1
     )
     assert cm1.task_seconds == cm2.task_seconds  # second call = cache hit
+    assert not cm1.cache_hit and cm2.cache_hit  # provenance of each object
+    assert cm1.measured_at and cm2.measured_at == cm1.measured_at
     assert set(cm1.task_seconds) == set(tiny_dag.graph.task_ids())
     assert cm1.apply(tiny_dag.graph) == len(tiny_dag.graph)
     loaded = CostModel.load(
         str(tmp_path / f"{tiny_dag.graph.name}_cpu.json")
     )
     assert loaded.task_seconds == cm1.task_seconds
+    # refresh=True bypasses the cache: a NEW measurement (fresh stamp
+    # allowed to differ; must not be marked a cache hit)
+    cm3 = calibrate_cached(
+        tiny_dag.graph, params, ids, cache_dir=str(tmp_path), repeats=1,
+        refresh=True,
+    )
+    assert not cm3.cache_hit
+    assert set(cm3.task_seconds) == set(tiny_dag.graph.task_ids())
+
+
+def test_cache_age_days_handles_naive_and_bad_stamps():
+    from distributed_llm_scheduler_tpu.utils.costmodel import cache_age_days
+
+    assert cache_age_days("") is None
+    assert cache_age_days("not-a-date") is None
+    # timezone-naive stamp (hand-edited artifact): assumed UTC, not a crash
+    age = cache_age_days("2026-07-30T00:00:00")
+    assert age is not None and age > 0
+    aware = cache_age_days("2026-07-30T00:00:00+00:00")
+    assert abs(age - aware) < 1e-6
 
 
 def test_vocab_sharded_dag_matches_fused_forward():
