@@ -30,6 +30,7 @@ Prints ONE JSON line on stdout; diagnostics go to stderr.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -38,9 +39,16 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main(config_name: str = None) -> None:
-    import os
+# the calibration caches and measured-bench snapshots live next to this
+# file; an invocation from another cwd must not silently recalibrate into
+# (or read snapshots from) a parallel tree, and mutating process-global
+# cwd would leak to in-process embedders (the `bench` CLI subcommand)
+CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".costmodel"
+)
 
+
+def main(config_name: str = None) -> None:
     import jax
 
     from distributed_llm_scheduler_tpu.eval.benchlib import probe_backend
@@ -111,7 +119,8 @@ def main(config_name: str = None) -> None:
         ids = dag.make_inputs()
         t0 = time.time()
         cm, cost_suffix = choose_cost_model(
-            graph, params, ids, devices[0], base_graph_name=base_name, log=log
+            graph, params, ids, devices[0], cache_dir=CACHE_DIR,
+            base_graph_name=base_name, log=log,
         )
         f32_fallback = False
     except Exception:
@@ -127,7 +136,8 @@ def main(config_name: str = None) -> None:
         ids = dag.make_inputs()
         t0 = time.time()
         cm, cost_suffix = choose_cost_model(
-            graph, params, ids, devices[0], base_graph_name=None, log=log
+            graph, params, ids, devices[0], cache_dir=CACHE_DIR,
+            base_graph_name=None, log=log,
         )
         f32_fallback = True
 
@@ -178,6 +188,7 @@ def measure(
     from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
     from distributed_llm_scheduler_tpu.eval.benchlib import (
         BenchResult,
+        best_of,
         choose_link,
         compute_mfu,
         graph_flops,
@@ -202,12 +213,9 @@ def measure(
     pt_reps, seg_reps, fused_reps = (
         (6, 16, 32) if platform == "tpu" else (2, 3, 4)
     )
-    pt_makespan = min(
-        backend.execute(
-            graph, sched_one, params, ids, warmup=False, reps=pt_reps
-        ).makespan_s
-        for _ in range(2)
-    )
+    pt_makespan = best_of(2, lambda: backend.execute(
+        graph, sched_one, params, ids, warmup=False, reps=pt_reps
+    ).makespan_s)
     fused_fn = jax.jit(dag.reference_forward)
     fused = fused_fn(params, ids)
     # fence-amortized timing: block_until_ready is unreliable through the
@@ -235,17 +243,13 @@ def measure(
     # fused_reps (32 on TPU) ≈ a 200+ ms window on this graph: tunnel RTT
     # jitter (a few ms) drops below a few percent of the measurement; the
     # CPU fallback's fences are cheap, so 4 reps suffice there
-    # min-of-3 windows: a single amortized window still swings with
-    # window-scale tunnel/tenant throughput dips (observed 11.3 vs
-    # 18.6 ms on the segmented leg across back-to-back runs); the
-    # minimum is the device-time estimator the calibrator already uses
+    # best-of-3 windows: window-scale tunnel/tenant throughput dips
+    # (observed 11.3 vs 18.6 ms on the segmented leg across back-to-back
+    # runs) inflate any single window
     fused_wall_s = max(
-        min(
-            time_amortized(
-                lambda: fused_scalar(params, ids), fused_reps, rtt
-            )
-            for _ in range(3)
-        ),
+        best_of(3, lambda: time_amortized(
+            lambda: fused_scalar(params, ids), fused_reps, rtt
+        )),
         1e-9,
     )
     fused_mfu = compute_mfu(
@@ -289,15 +293,12 @@ def measure(
         seg_oracle = oracle_close(fused, srep.output, dtype_name_oracle)
         # amortized over queued runs: the ~400 MB logits of in-flight
         # reps stay well under HBM, and the fence correction's residual
-        # error drops to sub-ms; min-of-3 windows nets out window-scale
+        # error drops to sub-ms; best-of-3 windows nets out window-scale
         # throughput dips (see fused_wall_s)
-        seg_makespan = min(
-            backend.execute(
-                graph, sched_one, params, ids, segments=True,
-                warmup=False, reps=seg_reps,
-            ).makespan_s
-            for _ in range(3)
-        )
+        seg_makespan = best_of(3, lambda: backend.execute(
+            graph, sched_one, params, ids, segments=True,
+            warmup=False, reps=seg_reps,
+        ).makespan_s)
         seg_mfu = compute_mfu(flops, seg_makespan, platform, dtype_name)
         log(f"bench: segment-fused single-chip makespan "
             f"{seg_makespan*1e3:.2f} ms ({srep.n_dispatches} launches vs "
@@ -332,7 +333,7 @@ def measure(
     # in the same regime as the cost model (measured where possible)
     hbm_gb = 14.0  # v5e: 16 GB HBM/core minus runtime reserve
     cluster = Cluster([DeviceState(f"core_{i}", hbm_gb) for i in range(8)])
-    link, link_prov = choose_link(cost_suffix)
+    link, link_prov = choose_link(cost_suffix, cache_dir=CACHE_DIR)
     log(f"bench: link model [{link_prov}] "
         f"host {link.param_load_gbps:.1f} GB/s, "
         f"ici {link.interconnect_gbps:.1f} GB/s, "
@@ -448,12 +449,12 @@ def measure(
     fresh_tpu = platform == "tpu" and not result.fallback and oracle_ok
     if fresh_tpu:
         try:
-            save_measured_snapshot(out, result.model_tag)
+            save_measured_snapshot(out, result.model_tag, CACHE_DIR)
             log("bench: snapshotted fresh TPU measurement")
         except Exception as e:
             log(f"bench: WARNING could not snapshot measurement: {e}")
     elif result.fallback:
-        snap = load_measured_snapshot(result.model_tag)
+        snap = load_measured_snapshot(result.model_tag, CACHE_DIR)
         if snap is not None:
             out["last_measured"] = snap
             log(f"bench: carrying forward last measured TPU line from "

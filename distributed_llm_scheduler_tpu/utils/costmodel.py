@@ -381,7 +381,9 @@ def cache_age_days(measured_at: str) -> Optional[float]:
     if then.tzinfo is None:  # naive stamp (hand-edited): assume UTC
         then = then.replace(tzinfo=datetime.timezone.utc)
     now = datetime.datetime.now(datetime.timezone.utc)
-    return (now - then).total_seconds() / 86400.0
+    # clamp: clock skew / hand-edited future stamps must not surface as
+    # "-0.0d old" in the provenance line this feeds
+    return max((now - then).total_seconds() / 86400.0, 0.0)
 
 
 def recalibrate_requested() -> bool:
@@ -389,7 +391,9 @@ def recalibrate_requested() -> bool:
     as ``refresh=`` so committed calibration caches can't masquerade as
     live measurements across rounds.  Library callers (and tests) are NOT
     env-sensitive — they get cache semantics unless they opt in."""
-    return os.environ.get("DLS_RECALIBRATE", "") not in ("", "0")
+    return os.environ.get("DLS_RECALIBRATE", "").strip().lower() not in (
+        "", "0", "false", "no", "off"
+    )
 
 
 def calibrate_cached(

@@ -251,3 +251,25 @@ def test_decode_inputs_bounds_check():
         decode_inputs(ids, 32, max_len=32)
     with pytest.raises(ValueError, match="exceeds"):
         decode_inputs(jnp.zeros((1, 8), jnp.int32), 25, max_len=32)
+
+
+def test_measure_decode_dag_bench_leg():
+    """The task-graph decode perf probe (eval/decode_bench.measure_decode_dag)
+    must produce a structurally complete report on the CPU mesh with the
+    greedy-token oracle holding — the shape contract DECODE_r{N}.json relies
+    on (timing magnitudes are only meaningful on the TPU)."""
+    from distributed_llm_scheduler_tpu.eval.decode_bench import (
+        measure_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    r = measure_decode_dag(
+        GPT2Config.tiny(), batch=2, prompt_len=16, new_tokens=4, reps=2
+    )
+    assert r["oracle_ok"], "task-graph logits must match forward_cached"
+    # at f32 tiny-vocab scale there are no argmax ties to flip
+    assert r["token_agreement"] == 1.0
+    assert r["graph_classes_compiled"] == 2  # prefill + one decode class
+    assert r["step_ms_per_task"] > 0
+    assert r["step_ms_segmented"] is not None and r["step_ms_segmented"] > 0
+    assert r["tok_s_end_to_end"] is not None and r["n_timed_steps"] == 2
