@@ -223,6 +223,12 @@ def choose_link(cost_suffix: str, cache_dir: str = ".costmodel"):
             f"{k}={v}" for k, v in sorted(cal.provenance.items())
         )
 
+    def _warn(msg: str) -> None:
+        import traceback
+
+        print(f"choose_link: WARNING {msg}:\n" + traceback.format_exc(),
+              file=sys.stderr)
+
     def _estimated():
         from ..backends.sim import LinkModel
 
@@ -237,6 +243,7 @@ def choose_link(cost_suffix: str, cache_dir: str = ".costmodel"):
 
     tpu_regime = cost_suffix in ("", "_tpu_cached", "_tpu_derived")
     if tpu_regime:
+        live_failed = False
         if cost_suffix == "" and jax.devices()[0].platform == "tpu":
             # live on a real TPU: calibrate_link_cached measures (or
             # cache-hits; DLS_RECALIBRATE re-measures — tunnel bandwidth
@@ -252,14 +259,9 @@ def choose_link(cost_suffix: str, cache_dir: str = ".costmodel"):
                 )
                 return cal.to_link_model(), _tpu_prov(cal)
             except Exception:
-                import traceback
-
-                print(
-                    "choose_link: WARNING live link calibration failed; "
-                    "falling back to cached/estimated link:\n"
-                    + traceback.format_exc(),
-                    file=sys.stderr,
-                )
+                _warn("live link calibration failed; falling back to "
+                      "cached/estimated link")
+                live_failed = True
         # cached/derived TPU costs, a non-TPU host, or a failed live
         # calibration: the TPU link can only come from a prior session's
         # calibration file (guarded: a corrupt file must degrade to the
@@ -270,15 +272,15 @@ def choose_link(cost_suffix: str, cache_dir: str = ".costmodel"):
         try:
             cal = LinkCalibration.load(path)
         except Exception:
-            import traceback
-
-            print(
-                f"choose_link: WARNING unreadable {path}; using estimated "
-                "link:\n" + traceback.format_exc(),
-                file=sys.stderr,
-            )
+            _warn(f"unreadable {path}; using estimated link")
             return _estimated()
-        return cal.to_link_model(), _tpu_prov(cal)
+        prov = _tpu_prov(cal)
+        if live_failed:
+            # a live-regime bench degraded to a prior session's file: the
+            # artifact (not just stderr) must say so — a stale cache may
+            # not masquerade as this session's measurement
+            prov = prov.replace("tpu:", "tpu_cached_fallback:", 1)
+        return cal.to_link_model(), prov
     cal = calibrate_link_cached(
         cache_dir=cache_dir, refresh=recalibrate_requested()
     )

@@ -239,11 +239,12 @@ def measure_decode_dag(
     * ``step_ms_segmented`` — same step with segment fusion (the
       production single-node dispatch mode: one XLA launch per step);
     * ``tok_s_end_to_end`` — wall tok/s of a host-driven generation: the
-      host must read each argmax token back before it can build the next
-      step's inputs, so this pays one device round-trip per token that
-      the one-program ``lax.scan`` path never pays.  On a tunneled device
-      that round-trip dominates; the step_ms fields are the device-side
-      truth.
+      argmax runs on device and the host reads the batch token ids back
+      (not the full logits) before it can fold the cache updates and
+      build the next step's inputs, so this pays one device round-trip
+      per token that the one-program ``lax.scan`` path never pays.  On a
+      tunneled device that round-trip dominates; the step_ms fields are
+      the device-side truth.
 
     Oracle: the task-graph path is TEACHER-FORCED on the whole-program
     ``generate`` token stream (so one bf16 argmax near-tie cannot cascade
@@ -354,7 +355,9 @@ def measure_decode_dag(
         # recomputation below is excluded (it is not generation work).
         t0 = _time.perf_counter()
         rep = step_exec(tok_ids, pos, params_c)
-        nxt = np.asarray(rep.output)[:, -1, :].argmax(-1)
+        # argmax on device, read back batch int32s — a real host-driven
+        # loop would not ship the full (B, vocab) logits over the link
+        nxt = np.asarray(jnp.argmax(rep.output[:, -1, :], axis=-1))
         # always folded, even on the last step whose update is never read:
         # every timed window must carry the same per-token host work
         next_params = apply_cache_updates(

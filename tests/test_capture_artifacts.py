@@ -1,0 +1,81 @@
+"""Wiring tests for eval/capture_artifacts: the one-shot artifact pass
+must place correctly-named files at the repo root, stamp platform/round,
+and degrade a failing leg to an error stub without losing the pass."""
+
+import json
+import os
+
+from distributed_llm_scheduler_tpu.eval import capture_artifacts as ca
+
+
+def test_capture_writes_stamped_artifacts(tmp_path, monkeypatch):
+    monkeypatch.setattr(ca, "REPO_ROOT", str(tmp_path))
+    monkeypatch.setitem(
+        ca.LEGS, "stream", ("STREAM", lambda: {"slowdown": 2.0})
+    )
+    rc = ca.main(["7", "stream"])
+    assert rc == 0
+    path = tmp_path / "STREAM_r07.json"
+    data = json.loads(path.read_text())
+    assert data["slowdown"] == 2.0
+    assert data["round"] == 7
+    assert data["platform"]  # stamped from the live jax platform
+    assert data["capture_wall_s"] >= 0
+
+
+def test_capture_failing_leg_degrades_to_stub(tmp_path, monkeypatch):
+    def boom():
+        raise RuntimeError("tunnel died")
+
+    monkeypatch.setattr(ca, "REPO_ROOT", str(tmp_path))
+    monkeypatch.setitem(ca.LEGS, "decode", ("DECODE", boom))
+    monkeypatch.setitem(
+        ca.LEGS, "stream", ("STREAM", lambda: {"slowdown": 1.0})
+    )
+    rc = ca.main(["4", "decode", "stream"])
+    assert rc == 1  # failure surfaced in the exit code...
+    stub = json.loads((tmp_path / "DECODE_r04.json").read_text())
+    assert "tunnel died" in stub["error"]
+    # ...but the healthy leg still captured
+    ok = json.loads((tmp_path / "STREAM_r04.json").read_text())
+    assert ok["slowdown"] == 1.0
+
+
+def test_capture_nested_suberror_surfaces_in_exit_code(tmp_path, monkeypatch):
+    """A sub-leg failure buried inside a composite artifact (e.g. the
+    decode artifact's attribution section) must still fail the pass."""
+    monkeypatch.setattr(ca, "REPO_ROOT", str(tmp_path))
+    monkeypatch.setitem(
+        ca.LEGS, "decode",
+        ("DECODE", lambda: {"decode_tok_s": 1.0,
+                            "attribution": {"error": "tunnel died"}}),
+    )
+    assert ca.main(["4", "decode"]) == 1
+    data = json.loads((tmp_path / "DECODE_r04.json").read_text())
+    assert data["decode_tok_s"] == 1.0  # healthy parts still recorded
+
+
+def test_capture_rejects_bad_args(tmp_path, monkeypatch):
+    monkeypatch.setattr(ca, "REPO_ROOT", str(tmp_path))
+    assert ca.main([]) == 2
+    assert ca.main(["x"]) == 2
+    assert ca.main(["4", "nosuchleg"]) == 2
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_measure_decode_dag_llama_family():
+    """The decode perf probe is family-generic: the llama backbone (GQA
+    cache layout, RoPE at the traced position) must satisfy the same
+    logits oracle through the scheduler."""
+    from distributed_llm_scheduler_tpu.eval.decode_bench import (
+        measure_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models.llama import LlamaConfig
+
+    r = measure_decode_dag(
+        LlamaConfig.tiny(), batch=2, prompt_len=16, new_tokens=3, reps=2
+    )
+    assert r["family"] == "llama"
+    assert r["oracle_ok"]
+    assert r["token_agreement"] == 1.0
+    assert r["graph_classes_compiled"] == 2
