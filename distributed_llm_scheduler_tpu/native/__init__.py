@@ -21,7 +21,7 @@ from typing import Optional
 _HERE = Path(__file__).resolve().parent
 _SOURCE = _HERE / "engine.cpp"
 _BUILD_DIR = _HERE / "_build"
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lock = threading.Lock()
 _engine: Optional[ctypes.CDLL] = None
@@ -39,6 +39,7 @@ POLICY_IDS = {
     "heft": 5,
     "pipeline": 6,
     "pack": 7,
+    "refine": 8,
 }
 
 
@@ -73,12 +74,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dls_schedule.restype = ctypes.c_int
     lib.dls_schedule.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        f64p, f64p,            # task_mem, task_time
+        f64p, f64p, f64p,      # task_mem, task_time, out_gb
         i32p, i32p,            # dep_off, dep_ids
         i32p, i32p,            # par_off, par_ids
         f64p, f64p, f64p,      # param_gb, node_mem, node_speed
         f64p,                  # link3
-        i32p,                  # group_ids (pipeline only; NULL otherwise)
+        i32p,                  # group_ids (group policies; NULL otherwise)
+        i32p, i32p,            # node_rank, group_rank (refine; NULL else)
         i32p, i32p, i32p,      # out_assign, out_order, out_n_assigned
     ]
     lib.dls_abi_version.restype = ctypes.c_int

@@ -1,8 +1,8 @@
 // Native list-scheduling engine.
 //
 // Implements the memory-constrained list-scheduling state machine and all
-// eight placement policies (roundrobin / dfs / greedy / critical / mru /
-// heft / pipeline / pack — see POLICY_IDS in __init__.py) over
+// nine placement policies (roundrobin / dfs / greedy / critical / mru /
+// heft / pipeline / pack / refine — see POLICY_IDS in __init__.py) over
 // a flattened, integer-indexed task graph.  Semantics are an exact mirror of
 // the Python policies in ../sched/{base,policies,heft}.py — which themselves
 // mirror the reference's observed behavior (reference schedulers.py:31-525) —
@@ -34,6 +34,9 @@ struct Graph {
   int n_tasks, n_params, n_nodes;
   const double* task_mem;    // [n_tasks] activation GB
   const double* task_time;   // [n_tasks] compute seconds at speed 1.0
+  const double* out_gb;      // [n_tasks] consumer-visible output GB
+                             // (TaskGraph.output_gb: out_bytes when known,
+                             // else the activation footprint)
   const int32_t* dep_off;    // [n_tasks+1] CSR offsets into dep_ids
   const int32_t* dep_ids;    // dependencies, task indices
   const int32_t* par_off;    // [n_tasks+1] CSR offsets into par_ids
@@ -443,7 +446,7 @@ void run_heft(Run& run, const double* link) {
   for (auto it = topo.rbegin(); it != topo.rend(); ++it) {
     int tid = *it;
     double w = g.task_time[tid] / mean_speed;
-    double comm = cross_frac * transfer_time(g.task_mem[tid]);
+    double comm = cross_frac * transfer_time(g.out_gb[tid]);
     double best_child = 0.0;
     for (int k = g.dpt_off[tid]; k < g.dpt_off[tid + 1]; ++k)
       best_child = std::max(best_child, comm + rank[g.dpt_ids[k]]);
@@ -497,7 +500,7 @@ void run_heft(Run& run, const double* link) {
       for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k) {
         int d = g.dep_ids[k];
         double arrive = finish[d];
-        if (run.assign[d] != node) arrive += transfer_time(g.task_mem[d]);
+        if (run.assign[d] != node) arrive += transfer_time(g.out_gb[d]);
         ready = std::max(ready, arrive);
       }
       double dur = g.task_time[tid] / g.node_speed[node];
@@ -541,12 +544,18 @@ void run_heft(Run& run, const double* link) {
 // ---------------------------------------------------------------------------
 
 struct EventOrder {
-  std::vector<int32_t> order;     // task ids by simulated start
+  std::vector<int32_t> order;      // task ids by simulated start
+  double makespan = 0.0;           // max finish over placed tasks
+  std::vector<double> node_finish; // [n_nodes] last finish (0 if absent)
+  std::vector<uint8_t> node_used;  // [n_nodes] node appears in placement
 };
 
-// dependency_aware_order: deepest-arrived-first per node (1F1B), else
-// earliest arrival; parameter prefetch queues per node in first-use order.
-EventOrder event_order(const Graph& g, const Run& run,
+// dependency_aware_order / simulate_placement (sched/eventsim.py):
+// deepest-arrived-first per node (1F1B), else earliest arrival; parameter
+// prefetch queues per node in first-use order.  Takes the assignment
+// vector directly (node index or -1 per task) so the refine policy can
+// score CANDIDATE placements without touching the Run.
+EventOrder event_order(const Graph& g, const std::vector<int32_t>& assign,
                        const std::vector<int32_t>& topo,
                        const double* link3) {
   const double load_gbps = link3[0], ici_gbps = link3[1], lat = link3[2];
@@ -578,12 +587,12 @@ EventOrder event_order(const Graph& g, const Run& run,
   std::vector<double> start_at(g.n_tasks, 0.0);
 
   for (int tid : topo) {
-    if (run.assign[tid] < 0) continue;
+    if (assign[tid] < 0) continue;
     int m = 0;
     for (int k = g.dep_off[tid]; k < g.dep_off[tid + 1]; ++k)
-      if (run.assign[g.dep_ids[k]] >= 0) ++m;
+      if (assign[g.dep_ids[k]] >= 0) ++m;
     missing[tid] = m;
-    if (m == 0) ready[run.assign[tid]].push_back({tid, 0.0});
+    if (m == 0) ready[assign[tid]].push_back({tid, 0.0});
   }
 
   // completion events: min-heap on (finish, topo_pos)
@@ -644,13 +653,13 @@ EventOrder event_order(const Graph& g, const Run& run,
     auto ev = events.top();
     events.pop();
     int tid = by_pos[ev.second];
-    int nid = run.assign[tid];
+    int nid = assign[tid];
     for (int k = g.dpt_off[tid]; k < g.dpt_off[tid + 1]; ++k) {
       int dep = g.dpt_ids[k];
-      if (run.assign[dep] < 0 || missing[dep] < 0) continue;
-      int dep_nid = run.assign[dep];
+      if (assign[dep] < 0 || missing[dep] < 0) continue;
+      int dep_nid = assign[dep];
       double arr = finish[tid];
-      if (dep_nid != nid) arr += transfer_time(g.task_mem[tid]);
+      if (dep_nid != nid) arr += transfer_time(g.out_gb[tid]);
       arrival[dep] = std::max(arrival[dep], arr);
       if (--missing[dep] == 0) {
         ready[dep_nid].push_back({dep, arrival[dep]});
@@ -664,11 +673,22 @@ EventOrder event_order(const Graph& g, const Run& run,
 
   EventOrder out;
   for (int tid : topo)
-    if (run.assign[tid] >= 0) out.order.push_back(tid);
+    if (assign[tid] >= 0) out.order.push_back(tid);
   std::stable_sort(out.order.begin(), out.order.end(), [&](int a, int b) {
     return start_at[a] < start_at[b] ||
            (start_at[a] == start_at[b] && topo_pos[a] < topo_pos[b]);
   });
+  // cost estimates (simulate_placement's exposed outputs): node_finish
+  // only over nodes that appear in the placement, like the Python dict
+  out.node_finish.assign(g.n_nodes, 0.0);
+  out.node_used.assign(g.n_nodes, 0);
+  for (int tid : out.order) {
+    int nid = assign[tid];
+    out.node_used[nid] = 1;
+    out.node_finish[nid] = std::max(out.node_finish[nid], finish[tid]);
+  }
+  for (int n = 0; n < g.n_nodes; ++n)
+    out.makespan = std::max(out.makespan, out.node_finish[n]);
   return out;
 }
 
@@ -965,22 +985,28 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
   }
 
   // re-order for execution (sched/eventsim.py semantics)
-  EventOrder eo = event_order(g, run, topo, link3);
+  EventOrder eo = event_order(g, run.assign, topo, link3);
   run.order = std::move(eo.order);
 }
 
-// Group-pack policy (sched/pack.py): non-contiguous LPT packing of groups
-// onto devices by resulting param-union load, then event-ordered execution.
-void run_pack(Run& run, const double* link3, const int32_t* group_ids) {
-  const Graph& g = run.g;
+// Group-pack planning (sched/pack.py GroupPackScheduler.plan): LPT packing
+// of groups onto devices by resulting param-union load.  `placed` maps
+// group -> device (-1: fits nowhere); `plan_order` lists the PLACED groups
+// in placement order — the Python dict's insertion order, which the refine
+// search's iteration order depends on.
+struct PackPlan {
+  std::vector<int32_t> placed;
+  std::vector<int32_t> plan_order;
+};
+
+PackPlan pack_plan(const Graph& g, const GroupStats& st) {
   int n_dev = g.n_nodes;
-  std::vector<int32_t> topo = g.toposort();
-  GroupStats st = group_stats(g, group_ids);
+  PackPlan plan;
+  plan.placed.assign(st.n_groups, -1);
 
   std::vector<std::vector<uint8_t>> dev_params(
       n_dev, std::vector<uint8_t>(g.n_params, 0));
   std::vector<double> dev_act(n_dev, 0.0);
-  std::vector<int32_t> placed(st.n_groups, -1);
 
   auto union_gb = [&](const std::vector<uint8_t>& m) {
     double sum = 0.0;  // ascending id == sorted-name order (parity)
@@ -1011,11 +1037,20 @@ void run_pack(Run& run, const double* link3, const int32_t* group_ids) {
       }
     }
     if (best_d < 0) continue;  // group fits nowhere: its tasks fail below
-    placed[gi] = best_d;
+    plan.placed[gi] = best_d;
+    plan.plan_order.push_back(gi);
     for (int p : st.gparams[gi]) dev_params[best_d][p] = 1;
     dev_act[best_d] = std::max(dev_act[best_d], st.activ[gi]);
   }
+  return plan;
+}
 
+// GroupPackScheduler.commit: assign per group placement in topo order with
+// the state machine's memory checks, then event-order the execution.
+void pack_commit(Run& run, const std::vector<int32_t>& placed,
+                 const int32_t* group_ids, const double* link3,
+                 const std::vector<int32_t>& topo) {
+  const Graph& g = run.g;
   for (int tid : topo) {
     if (!run.pending[tid]) continue;
     bool dep_failed = false;
@@ -1032,27 +1067,301 @@ void run_pack(Run& run, const double* link3, const int32_t* group_ids) {
       run.do_fail(tid);
     }
   }
-
-  EventOrder eo = event_order(g, run, topo, link3);
+  EventOrder eo = event_order(g, run.assign, topo, link3);
   run.order = std::move(eo.order);
+}
+
+// Group-pack policy (sched/pack.py): non-contiguous LPT packing of groups
+// onto devices by resulting param-union load, then event-ordered execution.
+void run_pack(Run& run, const double* link3, const int32_t* group_ids) {
+  const Graph& g = run.g;
+  std::vector<int32_t> topo = g.toposort();
+  GroupStats st = group_stats(g, group_ids);
+  PackPlan plan = pack_plan(g, st);
+  pack_commit(run, plan.placed, group_ids, link3, topo);
+}
+
+// ---------------------------------------------------------------------------
+// CPython-compatible Mersenne Twister.  The refine policy's basin hopping
+// uses random.Random(0) (sched/refine.py) — bit-identical parity requires
+// reproducing CPython's MT19937 exactly: init_by_array seeding over the
+// seed int's 32-bit digits, getrandbits(k) = genrand() >> (32-k), and
+// _randbelow's rejection sampling.  Reference implementation per
+// Matsumoto & Nishimura (the same code CPython vendors).
+// ---------------------------------------------------------------------------
+
+struct PyMT {
+  static constexpr int N = 624, M = 397;
+  uint32_t mt[N];
+  int mti = N + 1;
+
+  void init_genrand(uint32_t s) {
+    mt[0] = s;
+    for (mti = 1; mti < N; mti++)
+      mt[mti] = 1812433253U * (mt[mti - 1] ^ (mt[mti - 1] >> 30)) + mti;
+  }
+
+  // CPython random_seed(int n): key = |n|'s little-endian 32-bit digits
+  // (key [0] for n == 0), then init_by_array
+  explicit PyMT(uint32_t seed_int) {
+    uint32_t key[1] = {seed_int};  // seeds < 2^32 are a single digit
+    init_genrand(19650218U);
+    int i = 1, j = 0;
+    int k = N > 1 ? N : 1;
+    for (; k; k--) {
+      mt[i] = (mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1664525U)) +
+              key[j] + j;
+      i++; j++;
+      if (i >= N) { mt[0] = mt[N - 1]; i = 1; }
+      if (j >= 1) j = 0;
+    }
+    for (k = N - 1; k; k--) {
+      mt[i] = (mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1566083941U)) - i;
+      i++;
+      if (i >= N) { mt[0] = mt[N - 1]; i = 1; }
+    }
+    mt[0] = 0x80000000U;
+    mti = N;
+  }
+
+  uint32_t genrand() {
+    uint32_t y;
+    if (mti >= N) {
+      static const uint32_t mag01[2] = {0U, 0x9908b0dfU};
+      int kk;
+      for (kk = 0; kk < N - M; kk++) {
+        y = (mt[kk] & 0x80000000U) | (mt[kk + 1] & 0x7fffffffU);
+        mt[kk] = mt[kk + M] ^ (y >> 1) ^ mag01[y & 1U];
+      }
+      for (; kk < N - 1; kk++) {
+        y = (mt[kk] & 0x80000000U) | (mt[kk + 1] & 0x7fffffffU);
+        mt[kk] = mt[kk + (M - N)] ^ (y >> 1) ^ mag01[y & 1U];
+      }
+      y = (mt[N - 1] & 0x80000000U) | (mt[0] & 0x7fffffffU);
+      mt[N - 1] = mt[M - 1] ^ (y >> 1) ^ mag01[y & 1U];
+      mti = 0;
+    }
+    y = mt[mti++];
+    y ^= (y >> 11);
+    y ^= (y << 7) & 0x9d2c5680U;
+    y ^= (y << 15) & 0xefc60000U;
+    y ^= (y >> 18);
+    return y;
+  }
+
+  uint32_t getrandbits(int k) { return genrand() >> (32 - k); }
+
+  // Random._randbelow_with_getrandbits: rejection-sample k-bit draws
+  uint32_t randbelow(uint32_t n) {
+    int k = 0;
+    for (uint32_t v = n; v; v >>= 1) ++k;  // n.bit_length()
+    uint32_t r = getrandbits(k);
+    while (r >= n) r = getrandbits(k);
+    return r;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Refine policy (sched/refine.py RefinedPackScheduler): hill-climbed group
+// placement — pack's LPT plan as the seed, the event simulation as the
+// objective, first-improvement moves/swaps off the bottleneck device, then
+// seeded basin hopping with the remaining evaluation budget.
+// node_rank / group_rank: lexicographic ranks of node ids and group names
+// (the Python tie-breaks compare the STRINGS; the flattened graph only has
+// indices, so the ranks cross the ABI).
+// ---------------------------------------------------------------------------
+
+void run_refine(Run& run, const double* link3, const int32_t* group_ids,
+                const int32_t* node_rank, const int32_t* group_rank) {
+  const Graph& g = run.g;
+  const int n_dev = g.n_nodes;
+  constexpr int MAX_EVALS = 400;   // RefinedPackScheduler defaults
+  constexpr double TOL = 1e-9;
+  std::vector<int32_t> topo = g.toposort();
+  GroupStats st = group_stats(g, group_ids);
+  PackPlan plan = pack_plan(g, st);
+
+  if (plan.plan_order.empty() || n_dev <= 1) {
+    pack_commit(run, plan.placed, group_ids, link3, topo);
+    return;
+  }
+
+  auto union_of_group = [&](int gi) { return st.pg_of[gi]; };
+
+  // fits(assign, d): union of member groups' params + max member
+  // activation within the device budget (sorted-name == ascending-id sum)
+  std::vector<uint8_t> pmask(g.n_params);
+  auto fits = [&](const std::vector<int32_t>& assign, int d) {
+    std::fill(pmask.begin(), pmask.end(), 0);
+    double act = 0.0;
+    for (int gi : plan.plan_order) {
+      if (assign[gi] != d) continue;
+      for (int p : st.gparams[gi]) pmask[p] = 1;
+      act = std::max(act, st.activ[gi]);
+    }
+    double sum = 0.0;
+    for (int p = 0; p < g.n_params; ++p)
+      if (pmask[p]) sum += g.param_gb[p];
+    return sum + act <= g.node_mem[d] + 1e-9;
+  };
+
+  std::vector<int32_t> task_assign(g.n_tasks);
+  auto evaluate = [&](const std::vector<int32_t>& assign) {
+    for (int t = 0; t < g.n_tasks; ++t) {
+      int gi = group_ids[t];
+      task_assign[t] = plan.placed[gi] >= 0 ? assign[gi] : -1;
+    }
+    return event_order(g, task_assign, topo, link3);
+  };
+
+  int evals = 0;
+
+  // First-improvement hill climbing from one placement (refine.py climb)
+  auto climb = [&](std::vector<int32_t> cur, double cur_m,
+                   EventOrder nf) {
+    bool improved = true;
+    while (improved && evals < MAX_EVALS) {
+      improved = false;
+      // bottleneck device: max (finish, node_id) — rank breaks ties
+      int b_idx = -1;
+      for (int d = 0; d < n_dev; ++d) {
+        if (!nf.node_used[d]) continue;
+        if (b_idx < 0 || nf.node_finish[d] > nf.node_finish[b_idx] ||
+            (nf.node_finish[d] == nf.node_finish[b_idx] &&
+             node_rank[d] > node_rank[b_idx]))
+          b_idx = d;
+      }
+      if (b_idx < 0) break;  // nothing placed (cannot happen: plan known)
+      // groups on the bottleneck, heaviest param union first; stable ties
+      // keep plan-insertion order (Python dict iteration)
+      std::vector<int32_t> hot;
+      for (int gi : plan.plan_order)
+        if (cur[gi] == b_idx) hot.push_back(gi);
+      std::stable_sort(hot.begin(), hot.end(), [&](int a, int b) {
+        return union_of_group(a) > union_of_group(b);
+      });
+      // lighter devices first as destinations; stable ties keep index
+      std::vector<int32_t> dests(n_dev);
+      for (int d = 0; d < n_dev; ++d) dests[d] = d;
+      std::stable_sort(dests.begin(), dests.end(), [&](int a, int b) {
+        double fa = nf.node_used[a] ? nf.node_finish[a] : 0.0;
+        double fb = nf.node_used[b] ? nf.node_finish[b] : 0.0;
+        return fa < fb;
+      });
+      for (int gi : hot) {
+        if (evals >= MAX_EVALS || improved) break;
+        for (int d : dests) {
+          if (d == b_idx) continue;
+          // move gi -> d
+          std::vector<int32_t> cand = cur;
+          cand[gi] = d;
+          if (fits(cand, d)) {
+            EventOrder r = evaluate(cand);
+            ++evals;
+            if (r.makespan < cur_m - TOL) {
+              cur = std::move(cand);
+              cur_m = r.makespan;
+              nf = std::move(r);
+              improved = true;
+              break;
+            }
+            if (evals >= MAX_EVALS) break;
+          }
+          // swap gi <-> lightest group on d (first minimal in plan order)
+          int g2 = -1;
+          for (int gj : plan.plan_order) {
+            if (cur[gj] != d) continue;
+            if (g2 < 0 || union_of_group(gj) < union_of_group(g2)) g2 = gj;
+          }
+          if (g2 < 0) continue;
+          std::vector<int32_t> swp = cur;
+          swp[gi] = d;
+          swp[g2] = b_idx;
+          if (fits(swp, d) && fits(swp, b_idx)) {
+            EventOrder r = evaluate(swp);
+            ++evals;
+            if (r.makespan < cur_m - TOL) {
+              cur = std::move(swp);
+              cur_m = r.makespan;
+              nf = std::move(r);
+              improved = true;
+              break;
+            }
+            if (evals >= MAX_EVALS) break;
+          }
+        }
+      }
+    }
+    struct { std::vector<int32_t> a; double m; } out{std::move(cur), cur_m};
+    return out;
+  };
+
+  EventOrder seed_r = evaluate(plan.placed);
+  ++evals;
+  auto best0 = climb(plan.placed, seed_r.makespan, std::move(seed_r));
+  std::vector<int32_t> best = std::move(best0.a);
+  double best_m = best0.m;
+
+  // basin hopping (refine.py): perturb by up to 3 random feasible group
+  // moves under random.Random(0), re-climb, keep the global best
+  PyMT rng(0);
+  // glist = sorted(best): placed group names in lexicographic order
+  std::vector<int32_t> glist(plan.plan_order);
+  std::stable_sort(glist.begin(), glist.end(), [&](int a, int b) {
+    return group_rank[a] < group_rank[b];
+  });
+  int stale = 0;
+  while (evals + 2 < MAX_EVALS && !glist.empty() && stale < 10) {
+    std::vector<int32_t> cand = best;
+    for (int step = 0; step < 3; ++step) {
+      int gi = glist[rng.randbelow((uint32_t)glist.size())];
+      int d = (int)rng.randbelow((uint32_t)n_dev);
+      if (d != cand[gi]) {
+        std::vector<int32_t> moved = cand;
+        moved[gi] = d;
+        if (fits(moved, d)) cand = std::move(moved);
+      }
+    }
+    if (cand == best) {
+      ++stale;  // every proposed move was infeasible
+      continue;
+    }
+    stale = 0;
+    EventOrder r = evaluate(cand);
+    ++evals;
+    auto res = climb(std::move(cand), r.makespan, std::move(r));
+    if (res.m < best_m - TOL) {
+      best = std::move(res.a);
+      best_m = res.m;
+    }
+  }
+
+  pack_commit(run, best, group_ids, link3, topo);
 }
 
 }  // namespace
 
 extern "C" {
 
-// Returns 0 on success; -1 on bad policy id; -2 if policy 6 (pipeline) is
-// called without group_ids.  out_assign[t] = node index or -1 (failed);
+// Returns 0 on success; -1 on bad policy id; -2 if a group policy
+// (pipeline/pack/refine) is called without group_ids; -3 if refine lacks
+// node_rank/group_rank.  out_assign[t] = node index or -1 (failed);
 // out_order = task indices in final global assignment order, length via
 // *out_n_assigned.  group_ids: per-task group index (first-appearance order
-// over the topo sort), required for the pipeline policy, NULL otherwise.
+// over the topo sort), required for the group policies, NULL otherwise.
+// out_gb: per-task consumer-visible output GB (TaskGraph.output_gb) for
+// cross-node transfer charges; NULL falls back to task_mem.  node_rank /
+// group_rank: lexicographic ranks of node ids / group names (refine's
+// string tie-breaks), NULL except for refine.
 int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
                  const double* task_mem, const double* task_time,
+                 const double* out_gb,
                  const int32_t* dep_off, const int32_t* dep_ids,
                  const int32_t* par_off, const int32_t* par_ids,
                  const double* param_gb, const double* node_mem,
                  const double* node_speed, const double* link3,
                  const int32_t* group_ids,
+                 const int32_t* node_rank, const int32_t* group_rank,
                  int32_t* out_assign, int32_t* out_order,
                  int32_t* out_n_assigned) {
   Graph g;
@@ -1061,6 +1370,7 @@ int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
   g.n_nodes = n_nodes;
   g.task_mem = task_mem;
   g.task_time = task_time;
+  g.out_gb = out_gb != nullptr ? out_gb : task_mem;
   g.dep_off = dep_off;
   g.dep_ids = dep_ids;
   g.par_off = par_off;
@@ -1086,6 +1396,11 @@ int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
       if (group_ids == nullptr) return -2;
       run_pack(run, link3, group_ids);
       break;
+    case 8:
+      if (group_ids == nullptr) return -2;
+      if (node_rank == nullptr || group_rank == nullptr) return -3;
+      run_refine(run, link3, group_ids, node_rank, group_rank);
+      break;
     default: return -1;
   }
   std::memcpy(out_assign, run.assign.data(), sizeof(int32_t) * n_tasks);
@@ -1095,6 +1410,6 @@ int dls_schedule(int policy, int n_tasks, int n_params, int n_nodes,
   return 0;
 }
 
-int dls_abi_version() { return 2; }
+int dls_abi_version() { return 3; }
 
 }  // extern "C"

@@ -28,7 +28,7 @@ from .base import BaseScheduler
 
 
 class NativeScheduler(BaseScheduler):
-    """One of the eight policies, executed by the native engine."""
+    """One of the nine policies, executed by the native engine."""
 
     def __init__(self, policy: str, link=None):
         from ..native import POLICY_IDS
@@ -97,6 +97,9 @@ class NativeScheduler(BaseScheduler):
             par_off[i + 1] = len(par_ids)
         dep_arr = np.asarray(dep_ids, dtype=np.int32)
         par_arr = np.asarray(par_ids, dtype=np.int32)
+        out_gb = np.asarray(
+            [graph.output_gb(tid) for tid in tids], dtype=np.float64
+        )
         param_gb = np.asarray(
             [graph.param_size_gb(p) for p in params], dtype=np.float64
         )
@@ -109,7 +112,9 @@ class NativeScheduler(BaseScheduler):
         link3 = np.asarray(self._link, dtype=np.float64)
 
         group_ids = None
-        if self.policy in ("pipeline", "pack"):
+        node_rank = None
+        group_rank = None
+        if self.policy in ("pipeline", "pack", "refine"):
             # group index by first appearance over the TOPO order, matching
             # the Python _group_stats ordering (ungrouped: singleton groups)
             gidx: Dict[str, int] = {}
@@ -120,6 +125,18 @@ class NativeScheduler(BaseScheduler):
             group_ids = np.asarray(
                 [gidx[graph[t].group or t] for t in tids], dtype=np.int32
             )
+        if self.policy == "refine":
+            # refine's tie-breaks compare node-id / group-name STRINGS
+            # (bottleneck max, basin-hop glist = sorted(best)); the engine
+            # only sees indices, so ship each id's lexicographic rank
+            node_ids_ = cluster.ids()
+            pos = {nid: i for i, nid in enumerate(node_ids_)}
+            node_rank = np.empty(len(node_ids_), dtype=np.int32)
+            for r, nid in enumerate(sorted(node_ids_)):
+                node_rank[pos[nid]] = r
+            group_rank = np.empty(len(gidx), dtype=np.int32)
+            for r, glabel in enumerate(sorted(gidx)):
+                group_rank[gidx[glabel]] = r
 
         out_assign = np.empty(n, dtype=np.int32)
         out_order = np.empty(max(n, 1), dtype=np.int32)
@@ -133,11 +150,14 @@ class NativeScheduler(BaseScheduler):
         rc = engine.dls_schedule(
             POLICY_IDS[self.policy], n, len(params), len(cluster),
             ptr(task_mem, ctypes.c_double), ptr(task_time, ctypes.c_double),
+            ptr(out_gb, ctypes.c_double),
             ptr(dep_off, ctypes.c_int32), ptr(dep_arr, ctypes.c_int32),
             ptr(par_off, ctypes.c_int32), ptr(par_arr, ctypes.c_int32),
             ptr(param_gb, ctypes.c_double), ptr(node_mem, ctypes.c_double),
             ptr(node_speed, ctypes.c_double), ptr(link3, ctypes.c_double),
             None if group_ids is None else ptr(group_ids, ctypes.c_int32),
+            None if node_rank is None else ptr(node_rank, ctypes.c_int32),
+            None if group_rank is None else ptr(group_rank, ctypes.c_int32),
             ptr(out_assign, ctypes.c_int32), ptr(out_order, ctypes.c_int32),
             ptr(out_n, ctypes.c_int32),
         )

@@ -122,3 +122,22 @@ def test_parity_pipeline_repack_ties():
         py = ALL_SCHEDULERS[policy]().schedule(graph, Cluster.uniform(4, 100.0))
         nat = NativeScheduler(policy).schedule(graph, Cluster.uniform(4, 100.0))
         assert_same_schedule(py, nat, f"{policy}/repack-ties")
+
+
+def test_parity_with_out_bytes():
+    """Graphs whose tasks carry true output sizes (pre-flight out_bytes)
+    must still schedule identically: the engine's event ordering charges
+    cross-node transfers at TaskGraph.output_gb, not the activation proxy
+    (the two diverge exactly when out_bytes is set)."""
+    from distributed_llm_scheduler_tpu.core.cluster import DeviceState
+
+    graph = generate_llm_dag(num_layers=6, num_heads=3, seed=5)
+    # true outputs much smaller than activation footprints: transfer
+    # charges shrink, which reshuffles event order and refine's search
+    for i, tid in enumerate(graph.task_ids()):
+        graph[tid].out_bytes = (i % 7 + 1) * 1_000_000
+    cluster = Cluster([DeviceState(f"core_{i}", 8.0) for i in range(4)])
+    for policy in ("pipeline", "pack", "refine", "heft"):
+        py = get_scheduler(policy).schedule(graph, cluster)
+        nat = NativeScheduler(policy).schedule(graph, cluster)
+        assert_same_schedule(py, nat, f"{policy}+out_bytes")
