@@ -141,3 +141,50 @@ def test_parity_with_out_bytes():
         py = get_scheduler(policy).schedule(graph, cluster)
         nat = NativeScheduler(policy).schedule(graph, cluster)
         assert_same_schedule(py, nat, f"{policy}+out_bytes")
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29, 41, 53])
+def test_refine_parity_fuzz(seed):
+    """Fuzz the refine twin: random graphs + heterogeneous speeds + tight
+    memory hit different basin-hop trajectories (the RNG stream interacts
+    with feasibility), so each seed exercises fresh tie-break paths."""
+    import random as pyrandom
+
+    from distributed_llm_scheduler_tpu.core.cluster import DeviceState
+
+    r = pyrandom.Random(seed)
+    graph = generate_random_dag(num_tasks=40 + seed, seed=seed)
+    cluster = Cluster([
+        DeviceState(f"n{i}", 3.0 + 2.0 * r.random(),
+                    compute_speed=0.7 + 0.6 * r.random())
+        for i in range(r.randrange(2, 6))
+    ])
+    py = get_scheduler("refine").schedule(graph, cluster)
+    nat = NativeScheduler("refine").schedule(graph, cluster)
+    assert_same_schedule(py, nat, f"refine fuzz seed={seed}")
+
+
+def test_refine_parity_misaligned_node_ids():
+    """refine's bottleneck tie-break compares node-id STRINGS, which cross
+    the ABI as lexicographic ranks.  Every other fixture uses ids whose
+    sorted order equals cluster order, so the rank plumbing degenerates to
+    the identity there; this case uses ids sorted differently than their
+    indices (n1 < n10 < n2) and a symmetric graph engineered so multiple
+    devices tie on finish time — a wrong rank picks a different
+    bottleneck and diverges."""
+    from distributed_llm_scheduler_tpu import Task, TaskGraph
+    from distributed_llm_scheduler_tpu.core.cluster import DeviceState
+
+    graph = TaskGraph([
+        Task(
+            f"t{i:02d}", 0.1, 0.5,
+            params_needed={f"w{i:02d}"}, param_bytes={f"w{i:02d}": 2 << 28},
+        )
+        for i in range(12)  # identical independent tasks, one param each
+    ])
+    cluster = Cluster([
+        DeviceState("n2", 4.0), DeviceState("n10", 4.0), DeviceState("n1", 4.0)
+    ])
+    py = get_scheduler("refine").schedule(graph, cluster)
+    nat = NativeScheduler("refine").schedule(graph, cluster)
+    assert_same_schedule(py, nat, "refine misaligned node ids")
