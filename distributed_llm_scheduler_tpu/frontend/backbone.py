@@ -16,7 +16,7 @@ extractor, reference ``test_gpt2.py:54-166``.)
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 import jax
 import jax.numpy as jnp

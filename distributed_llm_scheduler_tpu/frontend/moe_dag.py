@@ -34,11 +34,9 @@ Two dispatch modes (VERDICT r3 next #4):
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 
 from ..models import mixtral

@@ -30,7 +30,7 @@ Differences (deliberate):
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Set, Tuple
 
 from ..core.cluster import Cluster, DeviceState
 from ..core.graph import Task, TaskGraph, TaskStatus

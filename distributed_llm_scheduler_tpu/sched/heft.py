@@ -22,7 +22,7 @@ same objective the replay measures, instead of a proxy.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set
+from typing import Dict, Optional
 
 from ..backends.sim import LinkModel
 from ..core.cluster import DeviceState

@@ -19,8 +19,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-from ..core.cluster import Cluster, DeviceState
-from ..core.graph import Task, TaskGraph
+from ..core.cluster import DeviceState
+from ..core.graph import Task
 from .base import BaseScheduler, SchedulerRun
 
 

@@ -37,7 +37,7 @@ union of its groups' params plus the largest single-task activation.
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 from ..backends.sim import LinkModel
 from .base import SchedulerRun

@@ -10,7 +10,7 @@ distributed_llm_scheduler_tpu <cmd>`` just works.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 
 @dataclasses.dataclass
