@@ -30,7 +30,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..core.graph import TaskGraph, is_batch0
+from ..core.graph import TaskGraph, is_batch0, rootslice_of
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,16 +116,26 @@ def plan_rebatch(graph: TaskGraph, tids: Sequence[str]) -> RebatchPlan:
     for t in order:
         task = graph[t]
         aids = task.arg_tasks or task.dependencies
+        rs = rootslice_of(task.fn) if task.fn is not None else None
         if (
             task.fn is not None
             and is_batch0(task.fn)
-            and aids  # roots consume the shared graph input: not batchable
+            and aids  # roots consume the shared graph input, not task args
             and _leading_dim(task.out_shape) is not None
         ):
             # full (local, global) pairs, not globals alone: members with
             # permuted param_alias mappings must NOT merge — the batched
             # call binds every member to member[0]'s loc->global mapping
             color[t] = ("fn", id(task.fn), tuple(task.param_items()))
+        elif (
+            rs is not None
+            and not aids
+            and _leading_dim(task.out_shape) is not None
+        ):
+            # slice-family root (mark_rootslice): the family key, not the
+            # fn identity — each member is a distinct (lo, hi) closure.
+            # Contiguity of the slices is checked after grouping.
+            color[t] = ("rootfn", rs[0], tuple(task.param_items()))
         else:
             color[t] = ("solo", t)
 
@@ -186,6 +196,26 @@ def plan_rebatch(graph: TaskGraph, tids: Sequence[str]) -> RebatchPlan:
         return all(not (anc[m] & mset) for m in members)
 
     candidate_classes = [m for m in candidate_classes if independent(m)]
+
+    # -- root classes: members must tile ONE contiguous slice range -------
+    # (re-ordered by lo so the class offsets equal the slice offsets; a
+    # gap or overlap demotes the whole class to singles)
+    checked: List[List[str]] = []
+    for members in candidate_classes:
+        m0 = graph[members[0]]
+        if m0.arg_tasks or m0.dependencies:
+            checked.append(members)
+            continue
+        slices = [rootslice_of(graph[m].fn) for m in members]
+        if any(s is None for s in slices):  # unreachable: color requires it
+            continue
+        by_lo = sorted(zip(members, slices), key=lambda p: p[1][1])
+        if all(
+            by_lo[i][1][2] == by_lo[i + 1][1][1]
+            for i in range(len(by_lo) - 1)
+        ):
+            checked.append([m for m, _ in by_lo])
+    candidate_classes = checked
 
     # -- argument alignment ------------------------------------------------
     kept: List[List[str]] = []
@@ -334,6 +364,17 @@ def build_rebatched_seg_fn(
             acc += plan.sizes[ci][mi]
         offsets.append(offs)
 
+    # merged-root classes (mark_rootslice): members tile one contiguous
+    # slice range (plan ordered them by lo), so the whole class is one
+    # call of the family's fn over [lo0, hiN) of the shared graph input
+    merged_root: Dict[int, Any] = {}
+    for ci, members in enumerate(plan.classes):
+        fn0, _, aids0 = step_info[members[0]]
+        if not aids0:
+            fam, lo0, _, make = rootslice_of(fn0)
+            _, _, hiN, _ = rootslice_of(step_info[members[-1]][0])
+            merged_root[ci] = make(lo0, hiN)
+
     # single tasks that are declared axis-0 concats of exactly one
     # batched class's members in order: identity on the batched value
     concat_passthrough: Dict[str, int] = {}
@@ -383,6 +424,11 @@ def build_rebatched_seg_fn(
                 members = plan.classes[ci]
                 fn, pitems, _ = step_info[members[0]]
                 pd = {loc: seg_params[g] for loc, g in pitems}
+                if ci in merged_root:
+                    # root class: one family call over the merged slice
+                    # of the shared graph input
+                    class_val[ci] = merged_root[ci](pd, ext["__input__"])
+                    continue
                 args = []
                 for j, srcs in enumerate(plan.arg_sources[ci]):
                     cj = plan.arg_class[ci][j]

@@ -67,6 +67,30 @@ def is_concat0(fn) -> bool:
     return bool(getattr(fn, "_dls_concat0", False))
 
 
+def mark_rootslice(fn, family, lo: int, hi: int, make):
+    """Declare a ROOT task fn (consumes the shared graph input, no task
+    args) to be the static batch-slice ``[lo, hi)`` instance of a slice
+    family: ``make(a, b)`` builds the family's fn for any range, and for
+    any split point ``a <= b <= c``::
+
+        make(a, c)(p, x) == concat([make(a, b)(p, x), make(b, c)(p, x)], 0)
+
+    True of per-row input transforms (embedding gathers over a batch
+    slice); the segment re-batching pass merges sibling roots whose
+    slices tile one contiguous range into a single ``make(lo0, hiN)``
+    call — the fused forward's full-batch gather, recovered whenever
+    placement co-locates the roots.  ``family`` must pin every closure
+    variable other than the slice (e.g. the vocab-shard bounds) so only
+    true siblings compare equal."""
+    fn._dls_rootslice = (family, int(lo), int(hi), make)
+    return fn
+
+
+def rootslice_of(fn):
+    """The ``(family, lo, hi, make)`` marker, or None."""
+    return getattr(fn, "_dls_rootslice", None)
+
+
 class TaskStatus(enum.Enum):
     PENDING = "pending"
     ASSIGNED = "assigned"

@@ -21,7 +21,13 @@ from typing import Any, Callable, Dict, List
 import jax
 import jax.numpy as jnp
 
-from ..core.graph import Task, TaskGraph, mark_batch0, mark_concat0
+from ..core.graph import (
+    Task,
+    TaskGraph,
+    mark_batch0,
+    mark_concat0,
+    mark_rootslice,
+)
 from .gpt2_dag import ModelDAG, make_task_adder
 from .vocab_sharding import logit_concat_fn, make_embed_partial_fn, shard_bounds
 
@@ -93,7 +99,9 @@ def build_decoder_dag(
         def f_embedding(p, input_ids):
             return module.embedding(input_ids[lo:hi], p["tok_emb"])
 
-        return f_embedding
+        return mark_rootslice(
+            f_embedding, "backbone_embedding", lo, hi, make_f_embedding
+        )
 
     @mark_concat0
     def f_concat(p, *chunks):

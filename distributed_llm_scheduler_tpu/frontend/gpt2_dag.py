@@ -28,7 +28,13 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from ..core.graph import Task, TaskGraph, mark_batch0, mark_concat0
+from ..core.graph import (
+    Task,
+    TaskGraph,
+    mark_batch0,
+    mark_concat0,
+    mark_rootslice,
+)
 from ..models import gpt2
 from ..models.gpt2 import GPT2Config
 from .vocab_sharding import logit_concat_fn, make_embed_partial_fn, shard_bounds
@@ -220,13 +226,16 @@ def build_gpt2_dag(
         def f_embedding(p, input_ids):
             return gpt2.embedding(input_ids[lo:hi], p["wte"], p["wpe"])
 
-        return f_embedding
+        return mark_rootslice(
+            f_embedding, "gpt2_embedding", lo, hi, make_f_embedding
+        )
 
     # batch-axis-0-polymorphic ops are marked for the segment re-batching
     # pass (backends/rebatch.py): per-token math, safe to run on sibling
-    # microbatches' concatenated inputs.  f_concat (axis-0 concat) and the
-    # embedding roots (static batch-slice closures) are deliberately NOT
-    # marked.
+    # microbatches' concatenated inputs.  f_concat (axis-0 concat) is NOT
+    # batch0; the embedding roots carry slice-family markers
+    # (mark_rootslice) so co-located siblings merge into full-batch
+    # gathers instead.
     @mark_batch0
     def f_embed_combine(p, *partials):
         T_ = partials[0].shape[-2]

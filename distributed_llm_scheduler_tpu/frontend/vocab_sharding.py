@@ -15,7 +15,7 @@ from typing import Callable, List
 
 import jax.numpy as jnp
 
-from ..core.graph import mark_batch0
+from ..core.graph import mark_batch0, mark_rootslice
 
 
 def shard_bounds(vocab_size: int, shards: int) -> List[int]:
@@ -47,7 +47,12 @@ def make_embed_partial_fn(
         emb = p["shard"][jnp.clip(local, 0, rows - 1)]
         return emb * mask[..., None].astype(emb.dtype)
 
-    return f_embed_partial
+    # slice family per vocab shard: sibling microbatch roots co-located in
+    # one segment merge into a single full-batch gather (rebatch pass)
+    return mark_rootslice(
+        f_embed_partial, ("embed_partial", lo_v, rows), lo_b, hi_b,
+        lambda a, b: make_embed_partial_fn(a, b, lo_v, rows),
+    )
 
 
 @mark_batch0  # last-axis concat: batch-axis-0 polymorphic

@@ -54,13 +54,20 @@ def test_plan_batches_microbatch_siblings(mb_setup):
     (node, tids, exports), = segs
     plan = plan_rebatch(graph, tids)
     assert plan.classes, "flagship structure must produce batched classes"
-    # every class: 8 microbatch members, mutually distinct, marked fns
+    # every class: 8 microbatch members, mutually distinct; either one
+    # shared batch0 fn, or a slice-family root class (distinct per-slice
+    # closures carrying the same mark_rootslice family)
+    from distributed_llm_scheduler_tpu.core.graph import rootslice_of
+
     for members in plan.classes:
         assert len(members) == 8
         assert len(set(members)) == 8
         fns = {id(graph[m].fn) for m in members}
-        assert len(fns) == 1
-        assert is_batch0(graph[members[0]].fn)
+        if len(fns) == 1:
+            assert is_batch0(graph[members[0]].fn)
+        else:
+            fams = {rootslice_of(graph[m].fn)[0] for m in members}
+            assert len(fams) == 1, "distinct fns only legal for one family"
     # batched tasks cover the per-layer chains (non-root, non-concat)
     assert plan.n_batched_tasks >= len(tids) * 2 // 3
     # units respect dependencies: sources appear before consumers
@@ -265,3 +272,63 @@ def test_rebatch_composes_with_quantization():
     np.testing.assert_allclose(
         np.asarray(fused), np.asarray(rep.output), rtol=2e-4, atol=2e-4
     )
+
+
+def test_root_slice_merging(mb_setup):
+    """Embedding roots (mark_rootslice) merge per vocab-shard family: the
+    mb8 x vs4 graph's 32 partial-gather roots become 4 classes of 8,
+    members ordered by slice lo, tiling the full batch."""
+    from distributed_llm_scheduler_tpu.core.graph import rootslice_of
+
+    dag, graph, params, ids = mb_setup
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend, sched, segs = _single_segment(graph, cluster)
+    (node, tids, exports), = segs
+    plan = plan_rebatch(graph, tids)
+    root_classes = [
+        c for c in plan.classes
+        if not (graph[c[0]].arg_tasks or graph[c[0]].dependencies)
+    ]
+    assert len(root_classes) == 4 and all(len(c) == 8 for c in root_classes)
+    for members in root_classes:
+        rs = [rootslice_of(graph[m].fn) for m in members]
+        assert len({r[0] for r in rs}) == 1  # one family per class
+        los = [r[1] for r in rs]
+        assert los == sorted(los)  # lo-ordered
+        assert all(rs[i][2] == rs[i + 1][1] for i in range(len(rs) - 1))
+        assert (rs[0][1], rs[-1][2]) == (0, 8)  # tiles the full batch
+    # end-to-end: merged-root segment program matches the fused forward
+    rep = backend.execute(graph, sched, params, ids, segments=True)
+    fused = dag.reference_forward(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(rep.output), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_root_merge_requires_contiguity():
+    """Roots whose slices do NOT tile one contiguous range (a co-located
+    subset with a gap) must demote to singles, not merge wrongly."""
+    from distributed_llm_scheduler_tpu.core.graph import (
+        Task,
+        TaskGraph,
+        mark_rootslice,
+    )
+
+    def make_root(lo, hi):
+        def f(p, x):
+            return x[lo:hi] * 2.0
+
+        return mark_rootslice(f, "double", lo, hi, make_root)
+
+    import jax.numpy as jnp
+
+    spec = jax.ShapeDtypeStruct((2, 4), jnp.float32)
+    # slices 0:2 and 4:6 of an (8, 4) input: same family, NOT contiguous
+    graph = TaskGraph([
+        Task("r0", 0.01, 1e-4, fn=make_root(0, 2), out_shape=spec),
+        Task("r1", 0.01, 1e-4, fn=make_root(4, 6), out_shape=spec),
+    ])
+    graph.freeze()
+    plan = plan_rebatch(graph, graph.task_ids())
+    assert not plan.classes, "gap-separated roots must not merge"
+    assert all(kind == "single" for kind, _ in plan.units)
