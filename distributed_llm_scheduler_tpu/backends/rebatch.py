@@ -197,9 +197,11 @@ def plan_rebatch(graph: TaskGraph, tids: Sequence[str]) -> RebatchPlan:
 
     candidate_classes = [m for m in candidate_classes if independent(m)]
 
-    # -- root classes: members must tile ONE contiguous slice range -------
-    # (re-ordered by lo so the class offsets equal the slice offsets; a
-    # gap or overlap demotes the whole class to singles)
+    # -- root classes: each class must tile ONE contiguous slice range ----
+    # (re-ordered by lo so the class offsets equal the slice offsets).
+    # A gap or overlap splits the members into maximal contiguous runs:
+    # co-located pairs still merge even when a sibling landed elsewhere;
+    # length-1 runs fall back to singles.
     checked: List[List[str]] = []
     for members in candidate_classes:
         m0 = graph[members[0]]
@@ -210,11 +212,16 @@ def plan_rebatch(graph: TaskGraph, tids: Sequence[str]) -> RebatchPlan:
         if any(s is None for s in slices):  # unreachable: color requires it
             continue
         by_lo = sorted(zip(members, slices), key=lambda p: p[1][1])
-        if all(
-            by_lo[i][1][2] == by_lo[i + 1][1][1]
-            for i in range(len(by_lo) - 1)
-        ):
-            checked.append([m for m, _ in by_lo])
+        run: List[str] = [by_lo[0][0]]
+        for i in range(1, len(by_lo)):
+            if by_lo[i - 1][1][2] == by_lo[i][1][1]:  # prev hi == lo
+                run.append(by_lo[i][0])
+            else:
+                if len(run) > 1:
+                    checked.append(run)
+                run = [by_lo[i][0]]
+        if len(run) > 1:
+            checked.append(run)
     candidate_classes = checked
 
     # -- argument alignment ------------------------------------------------

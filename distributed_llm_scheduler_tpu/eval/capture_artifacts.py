@@ -103,9 +103,18 @@ def capture_decode() -> Dict[str, Any]:
 
 
 def capture_train() -> Dict[str, Any]:
+    import jax
+
     from .train_bench import measure_train_dag
 
-    return measure_train_dag(cache_dir=CACHE_DIR)
+    if jax.devices()[0].platform == "tpu":
+        return measure_train_dag(cache_dir=CACHE_DIR)
+    # CPU-fallback scale, disclosed via the artifact's model tag: the
+    # full config-#5 step takes minutes per execution on a host, and the
+    # completion-cliff story (eviction-aware policies place 100% under
+    # the 0.55x pressure budget where critical/dfs drop tasks) is what
+    # the artifact exists to show
+    return measure_train_dag(batch=4, seq_len=128, cache_dir=CACHE_DIR)
 
 
 LEGS = {

@@ -332,3 +332,15 @@ def test_root_merge_requires_contiguity():
     plan = plan_rebatch(graph, graph.task_ids())
     assert not plan.classes, "gap-separated roots must not merge"
     assert all(kind == "single" for kind, _ in plan.units)
+
+    # a gap splits members into maximal contiguous runs: {0:2, 2:4, 6:8}
+    # merges the first pair and leaves the straggler single
+    g2 = TaskGraph([
+        Task("a", 0.01, 1e-4, fn=make_root(0, 2), out_shape=spec),
+        Task("b", 0.01, 1e-4, fn=make_root(2, 4), out_shape=spec),
+        Task("c", 0.01, 1e-4, fn=make_root(6, 8), out_shape=spec),
+    ])
+    g2.freeze()
+    p2 = plan_rebatch(g2, g2.task_ids())
+    assert p2.classes == (("a", "b"),)
+    assert ("single", "c") in p2.units
