@@ -266,6 +266,13 @@ def test_rebatch_composes_with_quantization():
     assert plan.n_batched_tasks > len(tids) // 2, (
         f"quantized graph lost batching: {plan.n_batched_tasks}/{len(tids)}"
     )
+    # root merging survives quantization too: the dequant wrapper
+    # propagates the slice-family marker with a wrapped constructor
+    root_classes = [
+        c for c in plan.classes
+        if not (qdag.graph[c[0]].arg_tasks or qdag.graph[c[0]].dependencies)
+    ]
+    assert root_classes, "quantized roots lost their slice families"
     params, ids = qdag.init_params(), qdag.make_inputs()
     rep = backend.execute(qdag.graph, sched, params, ids, segments=True)
     fused = qdag.reference_forward(params, ids)
