@@ -1,0 +1,110 @@
+"""bench.py watchdog: a mid-measurement tunnel wedge must degrade to the
+CPU fallback's JSON line, never to a hung process with no artifact."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+
+
+@pytest.fixture()
+def bench_mod():
+    spec = importlib.util.spec_from_file_location("bench_under_test", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _result(stdout: bytes, returncode: int = 0):
+    r = types.SimpleNamespace()
+    r.stdout = stdout
+    r.returncode = returncode
+    return r
+
+
+def test_watchdog_forwards_healthy_child(bench_mod, monkeypatch, capsys):
+    line = json.dumps({"metric": "m", "value": 1.0})
+    calls = []
+
+    def fake_run(cmd, env=None, stdout=None, timeout=None):
+        calls.append(env)
+        return _result((line + "\n").encode())
+
+    monkeypatch.setattr(bench_mod.subprocess_module, "run", fake_run)
+    assert bench_mod.run_with_watchdog("small") == 0
+    assert capsys.readouterr().out.strip() == line
+    assert len(calls) == 1
+    assert calls[0]["DLS_BENCH_NO_WATCHDOG"] == "1"
+    assert "DLS_PLATFORM" not in calls[0] or calls[0].get(
+        "DLS_PLATFORM"
+    ) == os.environ.get("DLS_PLATFORM")
+
+
+def test_watchdog_times_out_then_cpu_fallback(bench_mod, monkeypatch, capsys):
+    line = json.dumps({"metric": "m", "value": 2.0, "fallback": True})
+    calls = []
+
+    def fake_run(cmd, env=None, stdout=None, timeout=None):
+        calls.append(env)
+        if len(calls) == 1:  # the TPU attempt hangs
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        return _result((line + "\n").encode())
+
+    monkeypatch.setattr(bench_mod.subprocess_module, "run", fake_run)
+    assert bench_mod.run_with_watchdog("small") == 0
+    assert capsys.readouterr().out.strip() == line
+    assert len(calls) == 2
+    assert calls[1]["DLS_PLATFORM"] == "cpu"
+
+
+def test_watchdog_rejects_garbage_and_failure(bench_mod, monkeypatch):
+    attempts = iter([
+        _result(b"not json\n"),            # bad stdout
+        _result(b"", returncode=3),        # CPU fallback crashes too
+    ])
+
+    def fake_run(cmd, env=None, stdout=None, timeout=None):
+        return next(attempts)
+
+    monkeypatch.setattr(bench_mod.subprocess_module, "run", fake_run)
+    assert bench_mod.run_with_watchdog("small") == 1
+
+
+def test_child_env_skips_watchdog():
+    """End-to-end guard: invoking bench.py through the real interpreter
+    with a tiny timeout must still terminate (the watchdog enforces it)
+    and print whatever the fallback produced — here both children are
+    killed instantly, so it exits 1 with no stdout."""
+    env = {
+        **os.environ, "DLS_BENCH_TIMEOUT": "0.01",
+    }
+    env.pop("DLS_BENCH_NO_WATCHDOG", None)
+    r = subprocess.run(
+        [sys.executable, _BENCH, "small"], env=env,
+        capture_output=True, timeout=120,
+    )
+    assert r.returncode == 1
+    assert b"WATCHDOG" in r.stderr
+    assert not r.stdout.strip()
+
+
+def test_watchdog_skips_duplicate_cpu_attempt(bench_mod, monkeypatch):
+    """With DLS_PLATFORM=cpu already set, a failed attempt is
+    deterministic — the watchdog must not burn a second timeout budget
+    on an identical re-run."""
+    calls = []
+
+    def fake_run(cmd, env=None, stdout=None, timeout=None):
+        calls.append(env)
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setenv("DLS_PLATFORM", "cpu")
+    monkeypatch.setattr(bench_mod.subprocess_module, "run", fake_run)
+    assert bench_mod.run_with_watchdog("small") == 1
+    assert len(calls) == 1
