@@ -130,3 +130,50 @@ def test_catches_dropped_tasks_and_empty_schedule():
     empty = Schedule(policy="nothing")
     rep2 = validate_schedule(graph, cluster, empty)
     assert not rep2.ok
+
+
+def test_policy_fuzz_validates_under_pressure():
+    """Randomized sweep: every policy x random DAG families x tight and
+    loose memory regimes must emit schedules the independent checker
+    accepts (placed tasks only, deps ordered, no per-node overcommit) —
+    completion may legitimately drop under pressure, correctness may not."""
+    import random as pyrandom
+
+    from distributed_llm_scheduler_tpu.core.cluster import (
+        DeviceState,
+        estimate_cluster_memory_needed,
+    )
+    from distributed_llm_scheduler_tpu.frontend.generators import (
+        generate_pipeline_dag,
+        generate_random_dag,
+    )
+
+    builders = [
+        lambda s: generate_llm_dag(num_layers=3 + s % 4, seed=s),
+        lambda s: generate_random_dag(num_tasks=25 + s, seed=s),
+        lambda s: generate_pipeline_dag(
+            num_stages=3, tasks_per_stage=3, seed=s
+        ),
+    ]
+    checked = 0
+    for seed in (1, 2, 3):
+        r = pyrandom.Random(seed)
+        for build in builders:
+            graph = build(seed)
+            need = estimate_cluster_memory_needed(graph)
+            for regime in (1.1, 0.7):
+                n = r.randrange(2, 5)
+                cluster = Cluster([
+                    DeviceState(
+                        f"n{i}", need * regime / n,
+                        compute_speed=0.7 + 0.6 * r.random(),
+                    )
+                    for i in range(n)
+                ])
+                for name in ALL_SCHEDULERS:
+                    cl = copy.deepcopy(cluster)
+                    s = get_scheduler(name).schedule(graph, cl)
+                    rep = validate_schedule(graph, cl, s)
+                    assert rep.ok, (name, seed, regime, rep.summary())
+                    checked += 1
+    assert checked == 3 * 3 * 2 * len(ALL_SCHEDULERS)
