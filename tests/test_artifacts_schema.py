@@ -1,0 +1,81 @@
+"""Round-artifact schema guards.
+
+The driver and judge consume the committed ``*_r{N}.json`` artifacts; a
+capture refactor that silently drops a field (the r3 lesson: a fallback
+bench erased every measured field) should fail here, not be discovered a
+round later.  Values are NOT asserted — artifacts are re-captured on
+whatever platform is reachable; only structure and provenance fields are
+contractual.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _latest(prefix):
+    paths = sorted(glob.glob(os.path.join(ROOT, f"{prefix}_r*.json")))
+    if not paths:
+        pytest.skip(f"no {prefix} artifact committed")
+    return json.load(open(paths[-1])), paths[-1]
+
+
+def test_stream_artifact_schema():
+    d, path = _latest("STREAM")
+    for k in (
+        "platform", "budget_frac", "uncapped_makespan_ms",
+        "capped_makespan_ms", "slowdown", "param_loads", "param_evictions",
+        "peak_resident_param_gb", "budget_respected", "oracle_ok",
+        "bound_utilization",
+    ):
+        assert k in d, (path, k)
+    assert d["budget_respected"] is True
+    assert d["oracle_ok"] is True
+
+
+def test_decode_artifact_schema():
+    d, path = _latest("DECODE")
+    for k in ("platform", "decode_tok_s", "ms_per_token_step"):
+        assert k in d, (path, k)
+    att = d.get("attribution")
+    assert att and "error" not in att, path
+    for k in ("step_ms", "head_ms", "attn_ms", "sample_ms",
+              "loop_overhead_ms"):
+        assert k in att, (path, k)
+    tg = d.get("task_graph")
+    assert tg and "error" not in tg, path
+    for k in ("oracle_ok", "token_agreement", "step_ms_per_task",
+              "graph_classes_compiled"):
+        assert k in tg, (path, k)
+    assert tg["oracle_ok"] is True
+    # tp leg: either a real multi-device measurement or an honest skip
+    tp = d.get("tp_sharded")
+    assert tp and ("skipped" in tp or "tok_s_end_to_end" in tp), path
+
+
+def test_train_artifact_schema():
+    d, path = _latest("TRAIN")
+    for k in ("model", "platform", "oracle_ok", "policies",
+              "executed_step_ms"):
+        assert k in d, (path, k)
+    assert d["oracle_ok"] is True
+    for name, row in d["policies"].items():
+        assert "makespan_ms" in row and "completion" in row, (path, name)
+
+
+def test_bench_medium_artifact_schema():
+    d, path = _latest("BENCH_MEDIUM")
+    for k in ("metric", "value", "unit", "vs_baseline", "fallback"):
+        assert k in d, (path, k)
+    # provenance honesty: a fallback artifact must either carry the last
+    # measured line or be a fresh measurement itself
+    if d["fallback"]:
+        assert "last_measured" in d, (
+            f"{path}: fallback artifact dropped the measured record"
+        )
+        lm = d["last_measured"]
+        assert "measured_at" in lm and "result" in lm, path
