@@ -82,12 +82,26 @@ def _fit_affine(samples: Sequence[Tuple[int, float]]) -> Tuple[float, float]:
 
 @dataclass
 class LinkCalibration:
-    """Measured (or estimated) link parameters, with provenance per leg."""
+    """Measured (or estimated) link parameters, with provenance per leg.
+
+    ``param_load_gbps`` comes from a best-of-k *burst* probe per size —
+    the right model for the device backend's isolated per-task loads.
+    ``sustained_gbps`` times a back-to-back transfer train — the right
+    model for parameter *streaming*, which moves hundreds of MB in a
+    row.  On the tunneled TPU the two differ by ~50x (1.5 GB/s burst
+    vs ~0.03 GB/s sustained: the tunnel throttles sustained traffic),
+    which is why streaming makespans must be judged against the
+    sustained floor, not the burst one."""
 
     platform: str
     param_load_gbps: float = EST_HOST_GBPS
     interconnect_gbps: float = EST_ICI_GBPS
     latency_s: float = EST_LATENCY_S
+    sustained_gbps: Optional[float] = None
+    # last known HEALTHY measured burst rate: survives a degraded-window
+    # save, so the degradation guard keeps a baseline to compare future
+    # sessions against (otherwise one degraded save would blind it)
+    baseline_gbps: Optional[float] = None
     provenance: Dict[str, str] = field(
         default_factory=lambda: {
             "param_load": "estimated",
@@ -95,6 +109,7 @@ class LinkCalibration:
         }
     )
     samples: Dict[str, List[List[float]]] = field(default_factory=dict)
+    measured_at: str = ""
 
     def to_link_model(self):
         from ..backends.sim import LinkModel
@@ -117,6 +132,9 @@ class LinkCalibration:
                     "latency_s": self.latency_s,
                     "provenance": self.provenance,
                     "samples": self.samples,
+                    "measured_at": self.measured_at,
+                    "sustained_gbps": self.sustained_gbps,
+                    "baseline_gbps": self.baseline_gbps,
                 },
                 f,
                 indent=1,
@@ -134,6 +152,9 @@ class LinkCalibration:
             latency_s=d["latency_s"],
             provenance=d.get("provenance", {}),
             samples=d.get("samples", {}),
+            measured_at=d.get("measured_at", ""),
+            sustained_gbps=d.get("sustained_gbps"),
+            baseline_gbps=d.get("baseline_gbps"),
         )
 
 
@@ -186,6 +207,38 @@ def calibrate_link(
     cal.provenance["param_load"] = "measured"
     cal.samples["param_load"] = [[s, t] for s, t in host_samples]
 
+    # sustained host->device rate: a back-to-back train of puts, timed as
+    # one window.  Streaming workloads live in this regime, and on the
+    # tunneled TPU it is NOT the burst rate (observed ~50x slower; see
+    # class docstring) — the burst probe alone would set streaming an
+    # impossible floor.  Train size: 8 buffers of the largest swept size,
+    # capped at 16 MB each so the probe stays bounded even at ~0.03 GB/s.
+    chunk = min(max(sizes), 16 << 20)
+    n_bufs = 8
+    # best-of-2 windows, same estimator spirit as the burst leg's
+    # best-of-k: one window can land entirely inside a transient stall.
+    # Fresh source buffers per window (the _time_transfer rebuild
+    # contract): re-putting identical arrays could be elided/amortized
+    # by the runtime and over-read the rate.
+    windows: List[float] = []
+    for w in range(2):
+        train = [
+            np.random.default_rng(w * n_bufs + r).integers(
+                0, 255, chunk, dtype=np.uint8
+            )
+            for r in range(n_bufs)
+        ]
+        t0 = time.perf_counter()
+        outs = [jax.device_put(a, dev0) for a in train]
+        jax.block_until_ready(outs)
+        windows.append(time.perf_counter() - t0)
+        del outs
+    t_train = min((w for w in windows if w > 0), default=0.0)
+    if t_train > 0:
+        cal.sustained_gbps = (n_bufs * chunk) / t_train / 1024**3
+        cal.provenance["sustained"] = "measured"
+        cal.samples["sustained"] = [[n_bufs * chunk, w] for w in windows]
+
     # device -> device (interconnect leg) — needs a sibling device
     lat_d = None
     if len(devices) >= 2:
@@ -218,7 +271,40 @@ def calibrate_link(
     # both legs share)
     lats = [lat_h] + ([lat_d] if lat_d is not None else [])
     cal.latency_s = max(min(lats), 1e-7)
+    from .costmodel import _utc_stamp
+
+    cal.measured_at = _utc_stamp()
     return cal
+
+
+# A fresh measurement this much slower than the committed cache's measured
+# value marks a degraded transfer window (observed: the axon tunnel's host
+# leg collapsed 1.42 GB/s -> 0.039 GB/s for one whole calibration sweep,
+# then recovered minutes later — best-of-5 *within* the sweep cannot see
+# past a stall that outlives it)
+_DEGRADED_RATIO = 8.0
+
+
+def _healthy_baseline(prior: Optional[LinkCalibration]) -> Optional[float]:
+    """The best known-good measured burst rate from a prior calibration:
+    ``baseline_gbps`` survives degraded-window saves, so the guard keeps
+    working after it trips once."""
+    if prior is None:
+        return None
+    if prior.baseline_gbps and prior.baseline_gbps > 0:
+        return prior.baseline_gbps
+    if (prior.provenance.get("param_load") == "measured"
+            and prior.param_load_gbps > 0):
+        return prior.param_load_gbps
+    return None
+
+
+def _looks_degraded(fresh: LinkCalibration,
+                    prior: Optional[LinkCalibration]) -> bool:
+    base = _healthy_baseline(prior)
+    if base is None or fresh.param_load_gbps <= 0:
+        return False
+    return base / fresh.param_load_gbps > _DEGRADED_RATIO
 
 
 def calibrate_link_cached(
@@ -239,17 +325,45 @@ def calibrate_link_cached(
 
     devices = list(devices if devices is not None else jax.devices())
     path = os.path.join(cache_dir, f"link_{devices[0].platform}.json")
-    if not refresh and os.path.exists(path):
-        cal = LinkCalibration.load(path)
+    prior: Optional[LinkCalibration] = None
+    if os.path.exists(path):
+        try:
+            prior = LinkCalibration.load(path)
+        except Exception:
+            prior = None
+    if not refresh and prior is not None:
         # staleness check (cf. costmodel.calibrate_cached's task-set check):
         # a cache written in a 1-device session carries only an *estimated*
         # interconnect; once siblings exist, re-measure rather than letting
         # the estimate masquerade as calibration forever
         if (
-            cal.provenance.get("interconnect") == "measured"
+            prior.provenance.get("interconnect") == "measured"
             or len(devices) < 2
         ):
-            return cal
+            return prior
     cal = calibrate_link(devices, repeats=repeats)
+    if _looks_degraded(cal, prior):
+        # one retry after a pause: a transient tunnel stall should not
+        # overwrite a good cache with a 10x-slower link (which would turn
+        # every modeled makespan transfer-bound for the rest of the round)
+        time.sleep(5.0)
+        retry = calibrate_link(devices, repeats=repeats)
+        if retry.param_load_gbps > cal.param_load_gbps:
+            cal = retry
+        if _looks_degraded(cal, prior):
+            # both windows slow: this session's link really is degraded —
+            # keep the honest slow measurement, but say so in provenance
+            # (flows into the bench artifact's `link` field via
+            # benchlib.choose_link) so a reader can tell a degraded-tunnel
+            # artifact from a perf regression
+            base = _healthy_baseline(prior)
+            cal.provenance["param_load"] = (
+                f"measured-degraded(cache was {base:.2f}GB/s)"
+            )
+            # carry the healthy baseline forward so the NEXT session's
+            # guard still has something to compare against
+            cal.baseline_gbps = base
+    if cal.provenance.get("param_load") == "measured":
+        cal.baseline_gbps = cal.param_load_gbps
     cal.save(path)
     return cal

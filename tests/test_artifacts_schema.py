@@ -30,7 +30,8 @@ def test_stream_artifact_schema():
         "platform", "budget_frac", "uncapped_makespan_ms",
         "capped_makespan_ms", "slowdown", "param_loads", "param_evictions",
         "peak_resident_param_gb", "budget_respected", "oracle_ok",
-        "bound_utilization",
+        "bound_utilization", "achieved_gbps", "sustained_gbps",
+        "floor_source",
     ):
         assert k in d, (path, k)
     assert d["budget_respected"] is True

@@ -22,4 +22,9 @@ def test_measure_streaming_tiny():
     assert res["param_load_calls"] <= res["param_loads"]
     assert res["param_load_gb"] > 0
     assert res["host_link_gbps"] > 0
+    assert res["sustained_gbps"] > 0
     assert 0 < res["bound_utilization"] <= 1.5  # small slack for noise
+    # sustained end-to-end throughput; must be consistent with the bytes
+    # and makespan the same artifact reports
+    expect = res["param_load_gb"] / (res["capped_makespan_ms"] / 1e3)
+    assert abs(res["achieved_gbps"] - expect) < 0.01 * max(expect, 1.0)
