@@ -553,6 +553,10 @@ def cmd_generate(args) -> int:
         print("--task-graph generation is greedy; drop --temperature",
               file=sys.stderr)
         return 2
+    elif getattr(args, "kv_int8", False):
+        print("--kv-int8 applies to the whole-program decode loop; the "
+              "task-graph path places dense cache slabs", file=sys.stderr)
+        return 2
 
     import jax
     import jax.numpy as jnp
@@ -689,6 +693,7 @@ def cmd_generate(args) -> int:
             params, ids, config, max_new_tokens=args.max_new_tokens,
             temperature=args.temperature, top_k=args.top_k,
             key=jax.random.PRNGKey(args.seed),
+            kv_int8=bool(getattr(args, "kv_int8", False)),
         )
     except ValueError as e:  # e.g. past the model's position limit
         print(str(e), file=sys.stderr)
@@ -882,6 +887,11 @@ def main(argv=None) -> int:
                         "Llama / Mixtral weights (HF layout); random "
                         "init when omitted")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-int8", action="store_true", dest="kv_int8",
+                   help="store the KV cache as int8 with per-row scales "
+                        "(models/decode.quantize_cache): ~2x fewer cache "
+                        "bytes re-read per step; lossy (greedy tokens can "
+                        "differ from the bf16-cache run)")
     p.add_argument("--task-graph", action="store_true", dest="task_graph",
                    help="generate through the scheduling layer: decode "
                         "steps as task DAGs (KV-cache slabs as placeable "

@@ -87,6 +87,18 @@ def capture_decode() -> Dict[str, Any]:
     # its own name like the sibling sub-legs keep theirs
     out["whole_program_wall_s"] = out.pop("capture_wall_s", None)
     out["attribution"] = _guarded("decode.attribution", decode_attribution)
+    # int8 weights: decode is bandwidth-bound, so halving the weight
+    # bytes is the structural lever (the roofline in this leg reflects
+    # the quantized bytes)
+    out["quantized"] = _guarded("decode.quantized", lambda: {
+        k: (round(v, 4) if isinstance(v, float) else v)
+        for k, v in measure_decode(quantize=True).items()
+    })
+    # weights AND KV cache int8: both dominant byte terms halved
+    out["quantized_kv"] = _guarded("decode.quantized_kv", lambda: {
+        k: (round(v, 4) if isinstance(v, float) else v)
+        for k, v in measure_decode(quantize=True, kv_int8=True).items()
+    })
     out["task_graph"] = _guarded("decode.task_graph", measure_decode_dag)
     if len(jax.devices()) >= 2:
         out["tp_sharded"] = _guarded(

@@ -15,6 +15,7 @@ program, so the measurement is one fence-amortized timing of that program
 
 from __future__ import annotations
 
+import functools
 import sys
 from typing import Any, Dict, Optional
 
@@ -86,6 +87,28 @@ def decode_roofline(
     }
 
 
+@functools.lru_cache(maxsize=8)
+def _dequant_forward(family: str, dtype_name: str):
+    """ONE dequantizing forward_cached wrapper per (family, dtype).
+
+    ``models/decode._compiled_run`` keys its lru_cache on the forward
+    function's identity — a per-call closure would defeat it, re-tracing
+    and recompiling the whole generation program on every
+    ``measure_decode(quantize=True)`` call and pinning each orphaned
+    executable in that cache."""
+    from ..parallel.decode import _module_for
+    from ..utils.quantize import dequantize
+
+    mod = _module_for(family)
+    dt = jnp.dtype(dtype_name)
+
+    def fwd_q(p, *args, **kw):
+        dense = {k: dequantize(v, dt) for k, v in p.items()}
+        return mod.forward_cached(dense, *args, **kw)
+
+    return fwd_q
+
+
 def measure_decode(
     config: Any = None,
     batch: int = 8,
@@ -93,6 +116,8 @@ def measure_decode(
     new_tokens: int = 64,
     reps: int = 3,
     key: Optional[jax.Array] = None,
+    quantize: bool = False,
+    kv_int8: bool = False,
 ) -> Dict[str, float]:
     """Greedy-generation throughput: {decode_tok_s, wall_s, ...}.
 
@@ -103,6 +128,15 @@ def measure_decode(
     lengths — (wall(N) - wall(1)) / (N - 1) — so the prefill's cost
     cannot inflate the reported step latency; ``decode_tok_s`` derives
     from that differenced time.
+
+    ``quantize=True`` runs the same loop on int8 weights
+    (:mod:`..utils.quantize`): params live in HBM as ``(int8, scale)``
+    and dequantize inside the jitted step, so each token re-reads half
+    the weight bytes — decode is bandwidth-bound, so the roofline (and
+    ideally the measured rate) scales with the byte cut.  The report
+    gains ``token_agreement`` (greedy tokens vs the unquantized model;
+    int8 legitimately perturbs logits, so this is a fraction, not an
+    exactness claim) and the bound fields reflect the quantized bytes.
     """
     from ..parallel.decode import _family_of, _module_for
     from ..utils.costmodel import _fence_rtt, readback_fence, time_amortized
@@ -121,16 +155,48 @@ def measure_decode(
         dtype=jnp.int32,
     )
 
+    gen_params: Any = params
+    q_param_bytes: Optional[int] = None
+    token_agreement: Optional[float] = None
+    lossy = quantize or kv_int8
+    if quantize:
+        from ..models import decode as decode_mod
+        from ..utils.quantize import QParam, quantize_params
+
+        gen_params = quantize_params(params)
+        q_param_bytes = sum(
+            (v.q.nbytes + v.scale.nbytes) if isinstance(v, QParam)
+            else v.nbytes
+            for v in gen_params.values()
+        )
+        fwd_q = _dequant_forward(
+            _family_of(config), jnp.dtype(config.dtype).name
+        )
+
+        def generate(p, n):
+            return decode_mod.generate(
+                fwd_q, mod.init_cache, p, ids, config,
+                max_new_tokens=n, kv_int8=kv_int8,
+            )
+    else:
+        def generate(p, n):
+            return mod.generate(p, ids, config, max_new_tokens=n,
+                                kv_int8=kv_int8)
+    got_tokens: Optional[jax.Array] = None
+    if lossy:
+        # generated ONCE up front: doubles as the lossy path's compile
+        # warmup (timed() reuses the compiled program) and as the tokens
+        # the agreement metrics read — no redundant generation later
+        got_tokens = generate(gen_params, new_tokens)
+        ref_tokens = mod.generate(params, ids, config,
+                                  max_new_tokens=new_tokens)
+
     def timed(n: int) -> float:
-        out = mod.generate(params, ids, config, max_new_tokens=n)
+        out = generate(gen_params, n)
         readback_fence(out)  # compile + settle before timing
         rtt = _fence_rtt(jax.devices()[0])
         return max(
-            time_amortized(
-                lambda: mod.generate(params, ids, config, max_new_tokens=n),
-                reps,
-                rtt,
-            ),
+            time_amortized(lambda: generate(gen_params, n), reps, rtt),
             1e-9,
         )
 
@@ -146,10 +212,57 @@ def measure_decode(
         "decode_tok_s": batch / step_s,
         "ms_per_token_step": step_s * 1e3,
     }
+    if lossy:
+        got = got_tokens
+        agree = jnp.mean(
+            (got[:, prompt_len:] == ref_tokens[:, prompt_len:])
+            .astype(jnp.float32)
+        )
+        token_agreement = float(agree)
+        out["token_agreement"] = round(token_agreement, 4)
+        # sequence agreement compounds: one flipped argmax re-seeds every
+        # later step, so on random-init weights (near-tied logits) it
+        # understates fidelity.  First-token agreement has no compounding
+        # — it isolates how often int8 logits flip a single greedy pick.
+        out["first_token_agreement"] = round(float(jnp.mean(
+            (got[:, prompt_len] == ref_tokens[:, prompt_len])
+            .astype(jnp.float32)
+        )), 4)
+        out["weights"] = "int8" if quantize else jnp.dtype(
+            config.dtype).name
+        out["kv_cache"] = "int8" if kv_int8 else jnp.dtype(
+            config.dtype).name
     roof = decode_roofline(
         config, batch, prompt_len + new_tokens, jax.devices()[0].platform
     )
     if roof is not None:
+        # the residual write term (one cache row per step, kept for
+        # honesty in decode_roofline) survives quantized rebuilds
+        write_term = (
+            roof["bytes_per_step"] - roof["param_bytes"]
+            - roof["kv_cache_bytes"]
+        )
+        if q_param_bytes is not None:
+            # same roofline, quantized weight bytes: only the param
+            # re-read term shrinks
+            roof["param_bytes"] = float(q_param_bytes)
+        if kv_int8:
+            # int8 cache rows + one f32 scale per head_dim-sized row
+            hd = config.head_dim
+            itemsize = jnp.dtype(config.dtype).itemsize
+            elems = roof["kv_cache_bytes"] / itemsize
+            roof["kv_cache_bytes"] = float(elems + elems / hd * 4)
+        if q_param_bytes is not None or kv_int8:
+            roof["bytes_per_step"] = (
+                roof["param_bytes"] + roof["kv_cache_bytes"] + write_term
+            )
+            roof["step_bound_ms"] = round(
+                roof["bytes_per_step"] / (roof["hbm_gbps_assumed"] * 1e9)
+                * 1e3, 4,
+            )
+            roof["bound_tok_s"] = round(
+                batch / (roof["step_bound_ms"] / 1e3), 4
+            )
         out.update(roof)
         out["bound_utilization"] = (batch / step_s) / roof["bound_tok_s"]
     return out

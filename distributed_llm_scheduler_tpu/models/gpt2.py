@@ -357,8 +357,9 @@ def forward_cached(
 
         q, k, v = heads(q), heads(k), heads(v)
         cache = decode.update_layer_cache(cache, i, k, v, pos_start)
+        kc, vc, ks, vs = decode.layer_view(cache, i)
         att = decode.cached_attention(
-            q, cache["k"][i], cache["v"][i], pos_start, scale
+            q, kc, vc, pos_start, scale, k_scale=ks, v_scale=vs
         )
         att = att.transpose(0, 2, 1, 3).reshape(B, T, config.n_embd)
         x = x + (att @ params[p + "attn_proj_w"] + params[p + "attn_proj_b"])

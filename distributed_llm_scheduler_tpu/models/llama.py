@@ -356,9 +356,10 @@ def attention_cached(
     k = apply_rope(k, cos, sin)
 
     cache = decode.update_layer_cache(cache, layer, k, v, pos_start)
+    kc, vc, ks, vs = decode.layer_view(cache, layer)
     out = decode.cached_attention(
-        q, cache["k"][layer], cache["v"][layer], pos_start,
-        1.0 / math.sqrt(hd),
+        q, kc, vc, pos_start, 1.0 / math.sqrt(hd),
+        k_scale=ks, v_scale=vs,
     )
     out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
     return out @ block_params["wo"], cache

@@ -178,6 +178,7 @@ def calibrate_link(
     devices: Optional[Sequence[Any]] = None,
     sizes: Sequence[int] = _SIZES,
     repeats: int = 5,
+    sustained: bool = False,
 ) -> LinkCalibration:
     """Measure host->device and device->device transfer costs.
 
@@ -185,6 +186,11 @@ def calibrate_link(
     the host-load target; the first two (if available) form the
     interconnect pair.  One warmup transfer per leg absorbs one-time
     allocator/compile costs before timing.
+
+    ``sustained=True`` additionally times a back-to-back transfer train
+    (the streaming-regime rate — class docstring).  Opt-in because it
+    moves up to 2x8x16 MB, ~10 s through a throttled tunnel, and only
+    streaming consumers (``eval/stream_bench``) read it.
     """
     import jax
     import numpy as np
@@ -213,31 +219,34 @@ def calibrate_link(
     # class docstring) — the burst probe alone would set streaming an
     # impossible floor.  Train size: 8 buffers of the largest swept size,
     # capped at 16 MB each so the probe stays bounded even at ~0.03 GB/s.
-    chunk = min(max(sizes), 16 << 20)
-    n_bufs = 8
-    # best-of-2 windows, same estimator spirit as the burst leg's
-    # best-of-k: one window can land entirely inside a transient stall.
-    # Fresh source buffers per window (the _time_transfer rebuild
-    # contract): re-putting identical arrays could be elided/amortized
-    # by the runtime and over-read the rate.
-    windows: List[float] = []
-    for w in range(2):
-        train = [
-            np.random.default_rng(w * n_bufs + r).integers(
-                0, 255, chunk, dtype=np.uint8
-            )
-            for r in range(n_bufs)
-        ]
-        t0 = time.perf_counter()
-        outs = [jax.device_put(a, dev0) for a in train]
-        jax.block_until_ready(outs)
-        windows.append(time.perf_counter() - t0)
-        del outs
-    t_train = min((w for w in windows if w > 0), default=0.0)
-    if t_train > 0:
-        cal.sustained_gbps = (n_bufs * chunk) / t_train / 1024**3
-        cal.provenance["sustained"] = "measured"
-        cal.samples["sustained"] = [[n_bufs * chunk, w] for w in windows]
+    if sustained:
+        chunk = min(max(sizes), 16 << 20)
+        n_bufs = 8
+        # best-of-2 windows, same estimator spirit as the burst leg's
+        # best-of-k: one window can land entirely inside a transient
+        # stall.  Fresh source buffers per window (the _time_transfer
+        # rebuild contract): re-putting identical arrays could be
+        # elided/amortized by the runtime and over-read the rate.
+        windows: List[float] = []
+        for w in range(2):
+            train = [
+                np.random.default_rng(w * n_bufs + r).integers(
+                    0, 255, chunk, dtype=np.uint8
+                )
+                for r in range(n_bufs)
+            ]
+            t0 = time.perf_counter()
+            outs = [jax.device_put(a, dev0) for a in train]
+            jax.block_until_ready(outs)
+            windows.append(time.perf_counter() - t0)
+            del outs
+        t_train = min((w for w in windows if w > 0), default=0.0)
+        if t_train > 0:
+            cal.sustained_gbps = (n_bufs * chunk) / t_train / 1024**3
+            cal.provenance["sustained"] = "measured"
+            cal.samples["sustained"] = [
+                [n_bufs * chunk, w] for w in windows
+            ]
 
     # device -> device (interconnect leg) — needs a sibling device
     lat_d = None

@@ -31,7 +31,8 @@ def test_stream_artifact_schema():
         "capped_makespan_ms", "slowdown", "param_loads", "param_evictions",
         "peak_resident_param_gb", "budget_respected", "oracle_ok",
         "bound_utilization", "achieved_gbps", "sustained_gbps",
-        "floor_source",
+        "floor_source", "quantized_capped_makespan_ms",
+        "quantized_oracle_ok", "quantized_budget_respected",
     ):
         assert k in d, (path, k)
     assert d["budget_respected"] is True
@@ -53,6 +54,19 @@ def test_decode_artifact_schema():
               "graph_classes_compiled"):
         assert k in tg, (path, k)
     assert tg["oracle_ok"] is True
+    q = d.get("quantized")
+    if q is not None:  # int8 leg added mid-r4; absent from older captures
+        assert "error" not in q, path
+        assert q.get("weights") == "int8"
+        for k in ("decode_tok_s", "token_agreement",
+                  "first_token_agreement"):
+            assert k in q, (path, k)
+    qkv = d.get("quantized_kv")
+    if qkv is not None:
+        assert "error" not in qkv, path
+        assert qkv.get("weights") == "int8"
+        assert qkv.get("kv_cache") == "int8"
+        assert "decode_tok_s" in qkv, path
     # tp leg: either a real multi-device measurement or an honest skip
     tp = d.get("tp_sharded")
     assert tp and ("skipped" in tp or "tok_s_end_to_end" in tp), path

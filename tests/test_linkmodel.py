@@ -52,7 +52,8 @@ def link_cal():
     # small sizes keep the sweep fast; both legs measurable on the 8-device
     # CPU mesh
     return calibrate_link(
-        jax.devices(), sizes=(1 << 12, 1 << 16, 1 << 20, 1 << 23), repeats=3
+        jax.devices(), sizes=(1 << 12, 1 << 16, 1 << 20, 1 << 23),
+        repeats=3, sustained=True,
     )
 
 

@@ -28,3 +28,11 @@ def test_measure_streaming_tiny():
     # and makespan the same artifact reports
     expect = res["param_load_gb"] / (res["capped_makespan_ms"] / 1e3)
     assert abs(res["achieved_gbps"] - expect) < 0.01 * max(expect, 1.0)
+    # int8 leg: same budget, roughly half the streamed bytes, parity
+    # against its own quantized fused oracle — and the budget claim is
+    # checked, not assumed
+    assert res["quantized_oracle_ok"], res
+    assert res["quantized_param_load_gb"] < 0.6 * res["param_load_gb"]
+    assert res["quantized_capped_makespan_ms"] > 0
+    assert res["quantized_budget_respected"], res
+    assert res["quantized_peak_resident_gb"] <= res["budget_gb"] * 1.03
