@@ -47,16 +47,64 @@ def _has_error(d: Any) -> bool:
     return False
 
 
+class _LegTimeout(BaseException):
+    """BaseException, NOT Exception: the legs themselves wrap flaky
+    sub-phases in broad ``except Exception`` guards (stream_bench's
+    segmented/int8 phases, the nested decode sub-legs) — an
+    Exception-derived timeout would be swallowed right there, the alarm
+    would be spent, and the next blocking call on the wedged tunnel
+    would hang the pass with no protection left."""
+
+
 def _guarded(name: str, fn: Callable[[], Dict[str, Any]]) -> Dict[str, Any]:
+    """Run one capture leg with exception AND hang protection.
+
+    A tunnel wedge mid-leg (observed three times in one r4 session: a
+    blocking RPC that never returns) would otherwise stall the whole
+    sequential pass and lose every later leg.  SIGALRM (main thread,
+    Linux — exactly this script's environment) turns the hang into a
+    per-leg ``{"error": ...}`` stub; budget via ``DLS_CAPTURE_LEG_TIMEOUT``
+    seconds (default 1200, 0 disables)."""
+    import signal
+    import threading
+
+    budget = float(os.environ.get("DLS_CAPTURE_LEG_TIMEOUT", "1200"))
     t0 = time.time()
+
+    def _alarm(signum, frame):
+        raise _LegTimeout(f"leg exceeded {budget:.0f}s (tunnel wedge?)")
+
+    use_alarm = (
+        budget > 0
+        and hasattr(signal, "SIGALRM")
+        and threading.current_thread() is threading.main_thread()
+    )
+    prev_handler = prev_remaining = None
+    if use_alarm:
+        import math
+
+        prev_handler = signal.signal(signal.SIGALRM, _alarm)
+        # sub-legs nest (_guarded inside _guarded): remember the outer
+        # timer's remaining seconds so this leg's cleanup can re-arm it.
+        # ceil: alarm(int(0.5)) would be alarm(0) = CANCEL, silently
+        # disarming the protection a fractional budget asked for
+        prev_remaining = signal.alarm(max(1, int(math.ceil(budget))))
     try:
         out = fn()
         out["capture_wall_s"] = round(time.time() - t0, 1)
         return out
-    except Exception:
+    except (_LegTimeout, Exception):
         log(f"capture[{name}]: FAILED\n" + traceback.format_exc())
         return {"error": traceback.format_exc(limit=3),
                 "capture_wall_s": round(time.time() - t0, 1)}
+    finally:
+        if use_alarm:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, prev_handler)
+            if prev_remaining:
+                left = prev_remaining - (time.time() - t0)
+                # the outer leg already overran: let IT time out promptly
+                signal.alarm(max(1, int(left)))
 
 
 def capture_stream(budget_frac: float = 0.3) -> Dict[str, Any]:

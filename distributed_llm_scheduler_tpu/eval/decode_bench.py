@@ -755,6 +755,17 @@ if __name__ == "__main__":
         print(json.dumps(res))
         sys.exit(0)
 
+    if len(sys.argv) > 1 and sys.argv[1] in ("--int8", "--kv-int8"):
+        # --int8: weights + KV cache quantized; --kv-int8: cache only
+        res = measure_decode(
+            quantize=sys.argv[1] == "--int8", kv_int8=True
+        )
+        print(json.dumps({
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in res.items()
+        }))
+        sys.exit(0)
+
     if len(sys.argv) > 1 and (
         sys.argv[1] == "--tp" or sys.argv[1].startswith("--tp=")
     ):

@@ -43,6 +43,21 @@ def test_tp_generation_matches_single_device(family):
     assert np.array_equal(np.asarray(single), np.asarray(sharded))
 
 
+def test_tp_kv_int8_matches_single_device_kv_int8():
+    """The int8 KV cache composes with tensor-parallel decoding: the
+    quantized cache (values + per-row scales) inherits the head sharding
+    through GSPMD propagation exactly like the dense cache, and row-wise
+    absmax quantization is sharding-invariant (each row lives whole on
+    one shard), so tokens match the single-device kv_int8 run."""
+    mod, config, params, ids = _setup("llama")
+    single = mod.generate(params, ids, config, max_new_tokens=5,
+                          kv_int8=True)
+    mesh = make_mesh(dp=1, tp=2)
+    sharded = generate_sharded(params, ids, config, mesh,
+                               max_new_tokens=5, kv_int8=True)
+    assert np.array_equal(np.asarray(single), np.asarray(sharded))
+
+
 def test_llama_params_actually_sharded():
     _, config, params, _ = _setup("llama")
     mesh = make_mesh(dp=1, tp=2)
