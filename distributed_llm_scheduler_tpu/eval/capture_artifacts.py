@@ -113,6 +113,13 @@ def capture_stream(budget_frac: float = 0.3) -> Dict[str, Any]:
     return measure_streaming(budget_frac=budget_frac, log=log)
 
 
+def _rounded(d: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        k: (round(v, 4) if isinstance(v, float) else v)
+        for k, v in d.items()
+    }
+
+
 def capture_decode() -> Dict[str, Any]:
     """The decode artifact: whole-program roofline numbers, per-component
     attribution of the gap to the HBM bound, and the task-graph decode
@@ -126,10 +133,9 @@ def capture_decode() -> Dict[str, Any]:
         measure_decode_sharded,
     )
 
-    out = _guarded("decode.whole_program", lambda: {
-        k: (round(v, 4) if isinstance(v, float) else v)
-        for k, v in measure_decode().items()
-    })
+    out = _guarded(
+        "decode.whole_program", lambda: _rounded(measure_decode())
+    )
     # the whole_program dict becomes the artifact's top level, where
     # main()'s outer stamp would overwrite its wall time — keep it under
     # its own name like the sibling sub-legs keep theirs
@@ -138,15 +144,54 @@ def capture_decode() -> Dict[str, Any]:
     # int8 weights: decode is bandwidth-bound, so halving the weight
     # bytes is the structural lever (the roofline in this leg reflects
     # the quantized bytes)
-    out["quantized"] = _guarded("decode.quantized", lambda: {
-        k: (round(v, 4) if isinstance(v, float) else v)
-        for k, v in measure_decode(quantize=True).items()
-    })
+    out["quantized"] = _guarded(
+        "decode.quantized", lambda: _rounded(measure_decode(quantize=True))
+    )
     # weights AND KV cache int8: both dominant byte terms halved
-    out["quantized_kv"] = _guarded("decode.quantized_kv", lambda: {
-        k: (round(v, 4) if isinstance(v, float) else v)
-        for k, v in measure_decode(quantize=True, kv_int8=True).items()
-    })
+    out["quantized_kv"] = _guarded(
+        "decode.quantized_kv",
+        lambda: _rounded(measure_decode(quantize=True, kv_int8=True)),
+    )
+    # family breadth (the gpt2 numbers above are the roofline story;
+    # these pin the OTHER decode paths' measured rates): a GPT-2-small-
+    # class Llama (GQA 12:4 + RoPE + SwiGLU) and Mixtral (per-token
+    # top-2 routing in the decode step).  CPU fallback runs the tiny
+    # configs — a functional rehearsal, disclosed by the model field.
+    import jax.numpy as jnp
+
+    from ..models.llama import LlamaConfig
+    from ..models.mixtral import MixtralConfig
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    lcfg = (
+        LlamaConfig(
+            vocab_size=32_000, max_seq_len=1024, d_model=768,
+            n_layers=12, n_heads=12, n_kv_heads=4, ffn_hidden=2048,
+            dtype=jnp.bfloat16,
+        )
+        if on_tpu else LlamaConfig.tiny(dtype=jnp.bfloat16)
+    )
+    mcfg = (
+        MixtralConfig(
+            vocab_size=32_000, max_seq_len=1024, d_model=512,
+            n_layers=8, n_heads=8, n_kv_heads=4, ffn_hidden=1408,
+            n_experts=8, top_k=2, dtype=jnp.bfloat16,
+        )
+        if on_tpu else MixtralConfig.tiny(dtype=jnp.bfloat16)
+    )
+    # tiny configs cap max_seq_len at 128 — the CPU rehearsal must shrink
+    # the sequence budget with them (capture_train's CPU-scale pattern)
+    # or decode.generate's position-limit guard rejects every call
+    size_kw = {} if on_tpu else {"prompt_len": 64, "new_tokens": 16}
+    for name, cfg in (("llama", lcfg), ("mixtral", mcfg)):
+        out[name] = _guarded(
+            f"decode.{name}",
+            lambda cfg=cfg: _rounded(measure_decode(config=cfg, **size_kw)),
+        )
+        out[name]["model"] = (
+            f"{name}_{cfg.n_layers}l_d{cfg.d_model}_"
+            f"{jnp.dtype(cfg.dtype).name}"
+        )
     out["task_graph"] = _guarded("decode.task_graph", measure_decode_dag)
     if len(jax.devices()) >= 2:
         out["tp_sharded"] = _guarded(

@@ -67,6 +67,12 @@ def test_decode_artifact_schema():
         assert qkv.get("weights") == "int8"
         assert qkv.get("kv_cache") == "int8"
         assert "decode_tok_s" in qkv, path
+    for fam in ("llama", "mixtral"):
+        leg = d.get(fam)
+        if leg is not None:  # family legs added mid-r4
+            assert "error" not in leg, (path, fam)
+            assert leg.get("model", "").startswith(fam), (path, fam)
+            assert "decode_tok_s" in leg, (path, fam)
     # tp leg: either a real multi-device measurement or an honest skip
     tp = d.get("tp_sharded")
     assert tp and ("skipped" in tp or "tok_s_end_to_end" in tp), path
