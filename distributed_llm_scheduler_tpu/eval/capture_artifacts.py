@@ -113,13 +113,6 @@ def capture_stream(budget_frac: float = 0.3) -> Dict[str, Any]:
     return measure_streaming(budget_frac=budget_frac, log=log)
 
 
-def _rounded(d: Dict[str, Any]) -> Dict[str, Any]:
-    return {
-        k: (round(v, 4) if isinstance(v, float) else v)
-        for k, v in d.items()
-    }
-
-
 def capture_decode() -> Dict[str, Any]:
     """The decode artifact: whole-program roofline numbers, per-component
     attribution of the gap to the HBM bound, and the task-graph decode
@@ -127,6 +120,7 @@ def capture_decode() -> Dict[str, Any]:
     import jax
 
     from .decode_bench import (
+        _round4 as _rounded,
         decode_attribution,
         measure_decode,
         measure_decode_dag,

@@ -157,7 +157,6 @@ def measure_decode(
 
     gen_params: Any = params
     q_param_bytes: Optional[int] = None
-    token_agreement: Optional[float] = None
     lossy = quantize or kv_int8
     if quantize:
         from ..models import decode as decode_mod
@@ -214,12 +213,10 @@ def measure_decode(
     }
     if lossy:
         got = got_tokens
-        agree = jnp.mean(
+        out["token_agreement"] = round(float(jnp.mean(
             (got[:, prompt_len:] == ref_tokens[:, prompt_len:])
             .astype(jnp.float32)
-        )
-        token_agreement = float(agree)
-        out["token_agreement"] = round(token_agreement, 4)
+        )), 4)
         # sequence agreement compounds: one flipped argmax re-seeds every
         # later step, so on random-init weights (near-tied logits) it
         # understates fidelity.  First-token agreement has no compounding
@@ -741,6 +738,13 @@ def decode_attribution(
     return out
 
 
+def _round4(d):
+    return {
+        k: (round(v, 4) if isinstance(v, float) else v)
+        for k, v in d.items()
+    }
+
+
 if __name__ == "__main__":
     import json
     import sys
@@ -760,10 +764,7 @@ if __name__ == "__main__":
         res = measure_decode(
             quantize=sys.argv[1] == "--int8", kv_int8=True
         )
-        print(json.dumps({
-            k: (round(v, 4) if isinstance(v, float) else v)
-            for k, v in res.items()
-        }))
+        print(json.dumps(_round4(res)))
         sys.exit(0)
 
     if len(sys.argv) > 1 and (
@@ -779,14 +780,11 @@ if __name__ == "__main__":
             print("usage: decode_bench [--tp N]", file=sys.stderr)
             sys.exit(2)
         res = measure_decode_sharded(tp=tp)
-        print(json.dumps({
-            k: (round(v, 4) if isinstance(v, float) else v)
-            for k, v in res.items()
-        }))
+        print(json.dumps(_round4(res)))
         sys.exit(0)
 
     res = measure_decode()
-    print(json.dumps({k: round(v, 4) for k, v in res.items()}))
+    print(json.dumps(_round4(res)))
     bound = (
         f"; roofline bound {res['bound_tok_s']:.0f} tok/s "
         f"({res['bound_utilization']:.1%} of memory-bandwidth bound)"
