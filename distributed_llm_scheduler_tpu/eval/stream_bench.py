@@ -5,9 +5,11 @@ The reference's headline scenario is scheduling a 37.5 GB-param model onto
 eviction (reference ``schedulers.py:404-442``) — but it only ever
 *simulates* that.  This probe makes it physical on a real chip (VERDICT r2
 next #3): cap the node's parameter budget at a fraction of the model's
-total param bytes and execute with ``stream_params=True`` — params load on
-first use and the LRU streamer evicts residents to stay under budget, so
-the model runs correctly even though its weights never co-reside.
+total param bytes and execute with ``stream_params=True`` — prefetched
+batched loads with Belady (farthest-next-use) eviction keep residency
+under budget, so the model runs correctly even though its weights never
+co-reside.  Sibling legs measure the same budget with segment-fused
+dispatch and with int8 weights (half the streamed bytes).
 
 Run directly (on the TPU, or the CPU mesh for a functional check)::
 
