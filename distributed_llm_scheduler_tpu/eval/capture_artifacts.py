@@ -89,14 +89,31 @@ def _guarded(name: str, fn: Callable[[], Dict[str, Any]]) -> Dict[str, Any]:
         # ceil: alarm(int(0.5)) would be alarm(0) = CANCEL, silently
         # disarming the protection a fractional budget asked for
         prev_remaining = signal.alarm(max(1, int(math.ceil(budget))))
-    try:
-        out = fn()
-        out["capture_wall_s"] = round(time.time() - t0, 1)
-        return out
-    except (_LegTimeout, Exception):
+    def _stub() -> Dict[str, Any]:
         log(f"capture[{name}]: FAILED\n" + traceback.format_exc())
         return {"error": traceback.format_exc(limit=3),
                 "capture_wall_s": round(time.time() - t0, 1)}
+
+    try:
+        out = fn()
+        # disarm FIRST: the alarm could otherwise fire between fn()
+        # returning and the finally, escaping this frame entirely
+        if use_alarm:
+            signal.alarm(0)
+        out["capture_wall_s"] = round(time.time() - t0, 1)
+        return out
+    except _LegTimeout:
+        if not use_alarm:
+            # an ENCLOSING leg's timer fired while this frame ran without
+            # one of its own — not ours to swallow (doing so would spend
+            # the outer timer without re-arming it)
+            raise
+        signal.alarm(0)  # before traceback formatting, which takes time
+        return _stub()
+    except Exception:
+        if use_alarm:
+            signal.alarm(0)
+        return _stub()
     finally:
         if use_alarm:
             signal.alarm(0)

@@ -253,13 +253,13 @@ def measure_decode(
             roof["bytes_per_step"] = (
                 roof["param_bytes"] + roof["kv_cache_bytes"] + write_term
             )
-            roof["step_bound_ms"] = round(
-                roof["bytes_per_step"] / (roof["hbm_gbps_assumed"] * 1e9)
-                * 1e3, 4,
+            # derive both figures from the unrounded bound (matching
+            # decode_roofline's dense path), then round for the report
+            step_bound_s = roof["bytes_per_step"] / (
+                roof["hbm_gbps_assumed"] * 1e9
             )
-            roof["bound_tok_s"] = round(
-                batch / (roof["step_bound_ms"] / 1e3), 4
-            )
+            roof["step_bound_ms"] = round(step_bound_s * 1e3, 4)
+            roof["bound_tok_s"] = round(batch / step_bound_s, 4)
         out.update(roof)
         out["bound_utilization"] = (batch / step_s) / roof["bound_tok_s"]
     return out

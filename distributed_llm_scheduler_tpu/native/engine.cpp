@@ -1063,6 +1063,23 @@ void pack_commit(Run& run, const std::vector<int32_t>& placed,
     int node = placed[group_ids[tid]];
     if (node >= 0 && run.can_fit(tid, node)) {
       run.do_assign(tid, node);
+      continue;
+    }
+    // spill (sched/pack.py spill_pick): a task whose group fit nowhere
+    // whole degrades to singleton placement — min new-param-bytes device
+    // that fits, ties to the lower index (strict < over ascending scan)
+    int best = -1;
+    double best_req = 0.0;
+    for (int d = 0; d < g.n_nodes; ++d) {
+      double req = run.mem_requirement(tid, d);
+      if (req > run.avail[d] + 1e-9) continue;
+      if (best < 0 || req < best_req) {
+        best = d;
+        best_req = req;
+      }
+    }
+    if (best >= 0) {
+      run.do_assign(tid, best);
     } else {
       run.do_fail(tid);
     }

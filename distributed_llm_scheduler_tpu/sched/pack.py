@@ -83,7 +83,15 @@ class GroupPackScheduler(BaseScheduler):
 
     def commit(self, run: SchedulerRun, placed: Dict[str, int]) -> None:
         """Assign tasks per the group placement, then order execution with
-        the dependency-aware event simulation."""
+        the dependency-aware event simulation.
+
+        Graceful degradation (VERDICT r4 next #2): a task whose group fit
+        on no device whole — its param union exceeds every budget, the
+        config-#5 pressure cliff — or whose planned device can no longer
+        hold it is spilled through :meth:`spill_pick` instead of failed,
+        so group packing degrades toward greedy per-task placement rather
+        than zeroing out.  Completion-under-constraint is the reference's
+        headline metric (reference ``simulation.py:418-563``)."""
         graph, devices = run.graph, run.cluster.devices
         for tid in graph.topo_order:
             task = graph[tid]
@@ -95,6 +103,10 @@ class GroupPackScheduler(BaseScheduler):
             d = placed.get(task.group or tid)
             if d is not None and self.can_fit(run, task, devices[d]):
                 self.assign(run, task, devices[d])
+                continue
+            node = self.spill_pick(run, task, devices)
+            if node is not None:
+                self.assign(run, task, node)
             else:
                 self.fail(run, task)
 
@@ -111,3 +123,18 @@ class GroupPackScheduler(BaseScheduler):
         pos = {tid: i for i, tid in enumerate(exec_order)}
         for nid, tids in run.per_node.items():
             tids.sort(key=lambda t: pos[t])
+
+    def spill_pick(self, run: SchedulerRun, task, devices):
+        """Singleton fallback for a task the group plan could not place:
+        the device needing the fewest new param bytes that can fit it
+        (locality keeps total load bounded under pressure), ties to the
+        lower device index.  Deterministic — strict `<` improvement over
+        an index-ascending scan — for native-engine parity."""
+        best, best_req = None, None
+        for node in devices:
+            req = self.memory_requirement(run, task, node)
+            if req > node.available_memory + 1e-9:
+                continue
+            if best_req is None or req < best_req:
+                best, best_req = node, req
+        return best
