@@ -66,15 +66,17 @@ def run_with_watchdog(config_name: str) -> int:
     budget = float(os.environ.get("DLS_BENCH_TIMEOUT", "1500"))
     me = os.path.abspath(__file__)
 
-    def attempt(extra_env):
+    def attempt(extra_env, attempt_budget=None):
+        attempt_budget = attempt_budget or budget
         env = {**os.environ, "DLS_BENCH_NO_WATCHDOG": "1", **extra_env}
         try:
             r = subprocess_module.run(
                 [sys.executable, me, config_name],
-                env=env, stdout=subprocess_module.PIPE, timeout=budget,
+                env=env, stdout=subprocess_module.PIPE,
+                timeout=attempt_budget,
             )
         except subprocess_module.TimeoutExpired:
-            log(f"bench: WATCHDOG: child exceeded {budget:.0f}s "
+            log(f"bench: WATCHDOG: child exceeded {attempt_budget:.0f}s "
                 "(tunnel wedge?)")
             return None
         # errors="replace": a dying child can flush partial binary junk;
@@ -93,6 +95,19 @@ def run_with_watchdog(config_name: str) -> int:
         return line[-1]
 
     out = attempt({})
+    if out is None and os.environ.get("DLS_PLATFORM") != "cpu":
+        # one bounded TPU retry before CPU surrender (VERDICT r4 next #1:
+        # a single wedge was enough to make two consecutive round headlines
+        # modeled-CPU).  The retry is a FRESH child (clean tunnel session)
+        # on a lighter measurement leg (DLS_BENCH_LIGHT halves rep counts)
+        # so a slow-but-alive tunnel can still land a measured line inside
+        # a shorter budget.
+        retry_budget = float(
+            os.environ.get("DLS_BENCH_RETRY_TIMEOUT", str(budget * 0.8))
+        )
+        log(f"bench: WATCHDOG: retrying the TPU path once (fresh child, "
+            f"light reps, {retry_budget:.0f}s budget) before CPU surrender")
+        out = attempt({"DLS_BENCH_LIGHT": "1"}, attempt_budget=retry_budget)
     if out is None and os.environ.get("DLS_PLATFORM") != "cpu":
         # (already-CPU first attempts fail deterministically — an
         # identical re-run would only waste another timeout budget)
@@ -275,8 +290,13 @@ def measure(
     # Big rep counts exist to drown tunnel RTT; on the CPU fallback the
     # fence is cheap and each run is seconds, so scale reps down or the
     # degraded-path bench blows its time budget.
+    # DLS_BENCH_LIGHT (set by the watchdog's TPU retry): halved rep counts
+    # so a slow-but-alive tunnel fits a measured line in a shorter budget;
+    # amortization suffers a little, CPU surrender suffers the whole round
+    light = bool(os.environ.get("DLS_BENCH_LIGHT"))
     pt_reps, seg_reps, fused_reps = (
-        (6, 16, 32) if platform == "tpu" else (2, 3, 4)
+        ((3, 8, 16) if light else (6, 16, 32))
+        if platform == "tpu" else (2, 3, 4)
     )
     pt_makespan = best_of(2, lambda: backend.execute(
         graph, sched_one, params, ids, warmup=False, reps=pt_reps
@@ -524,6 +544,24 @@ def measure(
             out["last_measured"] = snap
             log(f"bench: carrying forward last measured TPU line from "
                 f"{snap['measured_at']} ({snap['age_days']} days old)")
+            # headline promotion (VERDICT r4 next #1): when the capture
+            # degraded but a RECENT real-TPU measurement exists, the
+            # top-level numbers are that measurement — a modeled-CPU
+            # headline with the truth buried one level down misled two
+            # consecutive rounds.
+            from distributed_llm_scheduler_tpu.eval.benchlib import (
+                promote_snapshot_headline,
+            )
+
+            max_age = float(
+                os.environ.get("DLS_PROMOTE_MAX_AGE_DAYS", "2")
+            )
+            promoted = promote_snapshot_headline(out, snap, max_age)
+            if promoted is not None:
+                out = promoted
+                log("bench: promoted the last measured TPU line to the "
+                    "headline (degraded line preserved under "
+                    "degraded_line)")
         else:
             log("bench: no prior measured snapshot to carry forward")
     print(json.dumps(out))

@@ -91,6 +91,7 @@ struct Run {
   std::vector<double> avail;          // [n_nodes] available GB
   std::vector<uint8_t> cached;        // [n_nodes * n_params]
   std::vector<int32_t> completed_on;  // [n_nodes] completed-task count
+  std::vector<double> busy;           // [n_nodes] compute backlog seconds
   std::vector<uint8_t> pending, completed, failed;  // [n_tasks]
   std::vector<int32_t> assign;        // [n_tasks] node or -1
   std::vector<int32_t> order;         // assignment order (task ids)
@@ -100,6 +101,7 @@ struct Run {
     avail.assign(g.node_mem, g.node_mem + g.n_nodes);
     cached.assign((size_t)g.n_nodes * g.n_params, 0);
     completed_on.assign(g.n_nodes, 0);
+    busy.assign(g.n_nodes, 0.0);
     pending.assign(g.n_tasks, 1);
     completed.assign(g.n_tasks, 0);
     failed.assign(g.n_tasks, 0);
@@ -144,6 +146,7 @@ struct Run {
     order.push_back(t);
     pending[t] = 0;
     --n_pending;
+    busy[node] += g.task_time[t] / g.node_speed[node];
     // complete_task
     avail[node] += g.task_mem[t];
     completed[t] = 1;
@@ -202,6 +205,22 @@ void round_loop(Run& run, OrderFn order_fn, PickFn pick_fn) {
   }
 }
 
+// Load-band eligibility (BaseScheduler.load_band): among fitting candidates,
+// only nodes with busy <= min_fitting_busy + FACTOR * task_time + 1e-12 may
+// be picked.  Returns +inf (everything eligible) when the task has no
+// compute time — mirroring the Python early return — or when nothing fits.
+constexpr double LOAD_BAND_FACTOR = 2.0;
+
+double band_threshold(Run& r, int t) {
+  if (r.g.task_time[t] <= 0.0)
+    return std::numeric_limits<double>::infinity();
+  double min_busy = std::numeric_limits<double>::infinity();
+  for (int node = 0; node < r.g.n_nodes; ++node)
+    if (r.can_fit(t, node)) min_busy = std::min(min_busy, r.busy[node]);
+  if (!std::isfinite(min_busy)) return min_busy;
+  return min_busy + LOAD_BAND_FACTOR * r.g.task_time[t] + 1e-12;
+}
+
 void run_roundrobin(Run& run) {
   int cursor = 0;  // persists across rounds, like the Python closure
   round_loop(
@@ -236,9 +255,10 @@ void run_dfs(Run& run) {
                          [&](int a, int b) { return depth[a] > depth[b]; });
       },
       [](Run& r, int t, const std::vector<int32_t>&) -> int {
+        double thresh = band_threshold(r, t);
         int best = -1;  // most available memory; first max kept on ties
         for (int node = 0; node < r.g.n_nodes; ++node)
-          if (r.can_fit(t, node) &&
+          if (r.can_fit(t, node) && r.busy[node] <= thresh &&
               (best < 0 || r.avail[node] > r.avail[best]))
             best = node;
         return best;
@@ -250,9 +270,10 @@ void run_greedy(Run& run) {
       run, [](Run&, std::vector<int32_t>&) {},
       [](Run& r, int t, const std::vector<int32_t>&) -> int {
         // min (params-to-load, -available); first best kept on ties
+        double thresh = band_threshold(r, t);
         int best = -1, best_load = 0;
         for (int node = 0; node < r.g.n_nodes; ++node) {
-          if (!r.can_fit(t, node)) continue;
+          if (!r.can_fit(t, node) || r.busy[node] > thresh) continue;
           int to_load = 0;
           for (int k = r.g.par_off[t]; k < r.g.par_off[t + 1]; ++k)
             if (!r.is_cached(node, r.g.par_ids[k])) ++to_load;
@@ -287,9 +308,10 @@ void run_critical(Run& run) {
       },
       [](Run& r, int t, const std::vector<int32_t>&) -> int {
         // fastest fitting node, tie-broken by available memory; first max
+        double thresh = band_threshold(r, t);
         int best = -1;
         for (int node = 0; node < r.g.n_nodes; ++node) {
-          if (!r.can_fit(t, node)) continue;
+          if (!r.can_fit(t, node) || r.busy[node] > thresh) continue;
           if (best < 0 || r.g.node_speed[node] > r.g.node_speed[best] ||
               (r.g.node_speed[node] == r.g.node_speed[best] &&
                r.avail[node] > r.avail[best]))
@@ -383,12 +405,26 @@ void run_mru(Run& run) {
                          [&](int a, int b) { return key[a] > key[b]; });
       },
       [&](Run& r, int t, const std::vector<int32_t>& ordered) -> int {
+        // candidates = eviction-feasible nodes; the load band applies on
+        // top (MRUScheduler.pick: plans for all nodes first, then the
+        // band filter, then scoring — plans are pure, so precomputing
+        // them is behavior-identical)
+        std::vector<Plan> plans(g.n_nodes);
+        double min_busy = std::numeric_limits<double>::infinity();
+        for (int node = 0; node < g.n_nodes; ++node) {
+          plans[node] = eviction_plan(r, t, node, ordered);
+          if (plans[node].ok) min_busy = std::min(min_busy, r.busy[node]);
+        }
+        double thresh =
+            (g.task_time[t] <= 0.0 || !std::isfinite(min_busy))
+                ? std::numeric_limits<double>::infinity()
+                : min_busy + LOAD_BAND_FACTOR * g.task_time[t] + 1e-12;
         int best = -1;
         double best_score = 0.0;
         Plan best_plan{false, {}};
         for (int node = 0; node < g.n_nodes; ++node) {
-          Plan plan = eviction_plan(r, t, node, ordered);
-          if (!plan.ok) continue;
+          Plan& plan = plans[node];
+          if (!plan.ok || r.busy[node] > thresh) continue;
           int overlap = 0;
           for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
             if (r.is_cached(node, g.par_ids[k])) ++overlap;
@@ -808,10 +844,13 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
 
   // contiguous-stage DP over remaining groups (plan_stages): lexicographic
   // (bottleneck stage cost, stages at that bottleneck), stage cost =
-  // max(compute, param-load time) — mirrors sched/pipeline.py exactly
+  // max(compute, param-load time) — mirrors sched/pipeline.py exactly.
+  // Stage s draws device (s-1) % n_dev's budget: with a virtual-stage
+  // factor v > 1 (the Megatron-style interleave sweep below) stages wrap
+  // cyclically over the devices, exactly like the Python side's
+  // devices * v list repetition.
   int n = (int)remaining.size();
   if (n > 0) {
-    int kmax = std::min(n, n_dev);
     std::vector<double> prefix(n + 1, 0.0);
     for (int i = 0; i < n; ++i)
       prefix[i + 1] = prefix[i] + compute[remaining[i]];
@@ -821,48 +860,51 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
                       ? link3[0]
                       : std::numeric_limits<double>::infinity();
     using Cost = std::pair<double, int32_t>;
-    std::vector<std::vector<Cost>> best(
-        n + 1, std::vector<Cost>(kmax + 1, {INF, 0}));
-    std::vector<std::vector<int32_t>> choice(
-        n + 1, std::vector<int32_t>(kmax + 1, -1));
-    best[0][0] = {0.0, 0};
     std::vector<uint8_t> inparams(g.n_params, 0);
-    for (int s = 1; s <= kmax; ++s) {
-      double cap = g.node_mem[s - 1] - reserved[s - 1];
-      for (int j = s; j <= n; ++j) {
-        std::fill(inparams.begin(), inparams.end(), 0);
-        double pg = 0.0, act = 0.0;
-        for (int i = j - 1; i >= s - 1; --i) {
-          for (int p : gparams[remaining[i]])
-            if (!inparams[p]) {
-              inparams[p] = 1;
-              pg += g.param_gb[p];
+    // bounds for a given stage budget, or empty when infeasible
+    auto plan = [&](int kmax) -> std::vector<int32_t> {
+      std::vector<std::vector<Cost>> best(
+          n + 1, std::vector<Cost>(kmax + 1, {INF, 0}));
+      std::vector<std::vector<int32_t>> choice(
+          n + 1, std::vector<int32_t>(kmax + 1, -1));
+      best[0][0] = {0.0, 0};
+      for (int s = 1; s <= kmax; ++s) {
+        int cd = (s - 1) % n_dev;
+        double cap = g.node_mem[cd] - reserved[cd];
+        for (int j = s; j <= n; ++j) {
+          std::fill(inparams.begin(), inparams.end(), 0);
+          double pg = 0.0, act = 0.0;
+          for (int i = j - 1; i >= s - 1; --i) {
+            for (int p : gparams[remaining[i]])
+              if (!inparams[p]) {
+                inparams[p] = 1;
+                pg += g.param_gb[p];
+              }
+            act = std::max(act, activ[remaining[i]]);
+            if (pg + act > cap + 1e-9) break;
+            if (best[i][s - 1].first >= INF) continue;
+            double cost = std::max(prefix[j] - prefix[i], pg / host);
+            Cost cand;
+            if (cost > best[i][s - 1].first) {
+              cand = {cost, 1};
+            } else if (cost == best[i][s - 1].first) {
+              cand = {best[i][s - 1].first, best[i][s - 1].second + 1};
+            } else {
+              cand = best[i][s - 1];
             }
-          act = std::max(act, activ[remaining[i]]);
-          if (pg + act > cap + 1e-9) break;
-          if (best[i][s - 1].first >= INF) continue;
-          double cost = std::max(prefix[j] - prefix[i], pg / host);
-          Cost cand;
-          if (cost > best[i][s - 1].first) {
-            cand = {cost, 1};
-          } else if (cost == best[i][s - 1].first) {
-            cand = {best[i][s - 1].first, best[i][s - 1].second + 1};
-          } else {
-            cand = best[i][s - 1];
-          }
-          if (cand < best[j][s]) {
-            best[j][s] = cand;
-            choice[j][s] = i;
+            if (cand < best[j][s]) {
+              best[j][s] = cand;
+              choice[j][s] = i;
+            }
           }
         }
       }
-    }
-    int s_best = -1;
-    for (int s = 1; s <= kmax; ++s)
-      if (best[n][s].first < INF &&
-          (s_best < 0 || best[n][s] < best[n][s_best]))
-        s_best = s;
-    if (s_best > 0) {
+      int s_best = -1;
+      for (int s = 1; s <= kmax; ++s)
+        if (best[n][s].first < INF &&
+            (s_best < 0 || best[n][s] < best[n][s_best]))
+          s_best = s;
+      if (s_best <= 0) return {};
       std::vector<int32_t> bounds(s_best + 1, 0);
       bounds[s_best] = n;
       int j = n;
@@ -870,9 +912,56 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
         j = choice[j][t];
         bounds[t - 1] = j;
       }
-      for (int s = 0; s < s_best; ++s)
+      return bounds;
+    };
+
+    // virtual-stage sweep (PipelineStageScheduler.run_policy): cost every
+    // interleave depth with the event simulation, keep the best (strictly
+    // lower makespan; ties prefer the shallower, more contiguous plan)
+    int vmax = std::max(1, std::min(4, (n + n_dev - 1) / n_dev));
+    bool have_best = false;
+    double best_cost = 0.0;
+    std::vector<int32_t> best_stage;
+    for (int v = 1; v <= vmax; ++v) {
+      std::vector<int32_t> bounds = plan(std::min(n, v * n_dev));
+      if (bounds.empty()) continue;
+      std::vector<int32_t> cand = stage_of_group;  // parked entries kept
+      int s_cnt = (int)bounds.size() - 1;
+      for (int s = 0; s < s_cnt; ++s)
         for (int i = bounds[s]; i < bounds[s + 1]; ++i)
-          stage_of_group[remaining[i]] = s;
+          cand[remaining[i]] = s % n_dev;
+      if (v > 1) {
+        // per-device union feasibility (_fits_per_device): the DP checks
+        // stages in isolation; v stages sharing a device must fit jointly
+        std::vector<std::vector<uint8_t>> u(
+            n_dev, std::vector<uint8_t>(g.n_params, 0));
+        std::vector<double> act(n_dev, 0.0);
+        for (int gi = 0; gi < n_groups; ++gi) {
+          int d = cand[gi];
+          if (d < 0) continue;
+          for (int p : gparams[gi]) u[d][p] = 1;
+          act[d] = std::max(act[d], activ[gi]);
+        }
+        bool ok = true;
+        for (int d = 0; d < n_dev && ok; ++d) {
+          double pg = 0.0;  // ascending id == sorted-name order (parity)
+          for (int p = 0; p < g.n_params; ++p)
+            if (u[d][p]) pg += g.param_gb[p];
+          if (pg + act[d] > g.node_mem[d] + 1e-9) ok = false;
+        }
+        if (!ok) continue;
+      }
+      std::vector<int32_t> cassign(g.n_tasks, -1);
+      for (int t = 0; t < g.n_tasks; ++t) cassign[t] = cand[group_ids[t]];
+      EventOrder eo = event_order(g, cassign, topo, link3);
+      if (!have_best || eo.makespan < best_cost) {
+        have_best = true;
+        best_cost = eo.makespan;
+        best_stage = cand;
+      }
+    }
+    if (have_best) {
+      stage_of_group = best_stage;
       // load-aware repack of parked groups (sched/pipeline.py
       // _rebalance_parked): greedily move them onto devices minimizing
       // the resulting param-union load, adopt only on strict improvement

@@ -54,6 +54,9 @@ class SchedulerRun:
         self.param_locations: Dict[str, Set[str]] = {}
         self.per_node: Dict[str, List[str]] = {d.node_id: [] for d in cluster}
         self.assignment_order: List[str] = []
+        # accumulated compute backlog (speed-adjusted seconds) per node;
+        # feeds the load-band eligibility filter (BaseScheduler.load_band)
+        self.busy: Dict[str, float] = {d.node_id: 0.0 for d in cluster}
         # per-task params in name order, computed once: deterministic float
         # accumulation (native parity) without re-sorting in the hot loops
         self._sorted_params: Dict[str, Tuple[str, ...]] = {}
@@ -104,6 +107,31 @@ class BaseScheduler:
     def can_fit(self, run: SchedulerRun, task: Task, node: DeviceState) -> bool:
         return self.memory_requirement(run, task, node) <= node.available_memory + 1e-9
 
+    # Load-band eligibility: how many task-times of compute backlog a
+    # candidate may trail the least-backlogged candidate by and still be
+    # preferred for locality.  The reference's policies have no load term
+    # at all, which concentrates work catastrophically at scale — greedy
+    # placed a 5,169-task Llama graph 11x worse than round-robin because
+    # the node holding a layer's weights wins every microbatch of that
+    # layer forever (ICI_r04.json; VERDICT r4 next #3).  2.0 keeps all
+    # four banded policies within 1.7x of round-robin on that probe while
+    # preserving 1.6-3x the cache hits; float('inf') recovers the
+    # reference's unbanded behavior.
+    LOAD_BAND_FACTOR = 2.0
+
+    def load_band(self, run: SchedulerRun, task: Task,
+                  nodes: List[DeviceState]) -> List[DeviceState]:
+        """Filter ``nodes`` (fitting candidates) to those whose compute
+        backlog is within ``LOAD_BAND_FACTOR`` task-times of the least
+        backlogged.  Never empties a non-empty list (the min-busy node is
+        always eligible), so completion semantics are unchanged — only
+        concentration is bounded."""
+        if len(nodes) <= 1 or task.compute_time <= 0.0:
+            return nodes
+        min_busy = min(run.busy[n.node_id] for n in nodes)
+        thresh = min_busy + self.LOAD_BAND_FACTOR * task.compute_time + 1e-12
+        return [n for n in nodes if run.busy[n.node_id] <= thresh]
+
     # -- transitions -------------------------------------------------------
     def assign(self, run: SchedulerRun, task: Task, node: DeviceState) -> None:
         """Load params, debit memory, place task — then instantly complete.
@@ -127,6 +155,7 @@ class BaseScheduler:
         run.per_node[node.node_id].append(task.task_id)
         run.assignment_order.append(task.task_id)
         run.pending.discard(task.task_id)
+        run.busy[node.node_id] += task.compute_time / node.compute_speed
         self.complete(run, task, node)
 
     def complete(self, run: SchedulerRun, task: Task, node: DeviceState) -> None:

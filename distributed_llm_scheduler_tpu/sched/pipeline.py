@@ -36,7 +36,7 @@ from ..backends.sim import LinkModel
 from ..core.cluster import DeviceState
 from ..core.graph import TaskGraph
 from .base import BaseScheduler, SchedulerRun
-from .eventsim import dependency_aware_order
+from .eventsim import dependency_aware_order, simulate_placement
 
 _INF = float("inf")
 
@@ -164,6 +164,33 @@ class PipelineStageScheduler(BaseScheduler):
             j = choice[j][t]
             bounds[t - 1] = j
         return bounds
+
+    def _fits_per_device(
+        self,
+        graph: TaskGraph,
+        devices: List[DeviceState],
+        all_groups: List[str],
+        all_gparams: List[Set[str]],
+        all_activ: List[float],
+        stage_map: Dict[str, int],
+    ) -> bool:
+        """Per-device feasibility for interleaved plans: the DP checks each
+        stage against its device's budget in isolation, but with v stages
+        per device the param-union across stages is what must fit."""
+        n_dev = len(devices)
+        params: List[Set[str]] = [set() for _ in range(n_dev)]
+        act = [0.0] * n_dev
+        for gi, g in enumerate(all_groups):
+            d = stage_map.get(g)
+            if d is None:
+                continue
+            params[d] |= all_gparams[gi]
+            act[d] = max(act[d], all_activ[gi])
+        for d in range(n_dev):
+            pg = sum(graph.param_size_gb(p) for p in sorted(params[d]))
+            if pg + act[d] > devices[d].total_memory + 1e-9:
+                return False
+        return True
 
     # -- parked-group rebalancing -----------------------------------------
     def _rebalance_parked(
@@ -333,13 +360,58 @@ class PipelineStageScheduler(BaseScheduler):
             [all_activ[i] for i in remaining],
             [all_gparams[i] for i in remaining],
         )
-        bounds = self.plan_stages(graph, devices, stats, reserved)
         groups, _, activ, gparams = stats
 
+        # Virtual-stage interleaving (Megatron-LM style): stage s pins to
+        # device s % n_dev, so v stages per device shrink the fill/drain
+        # bubble from (S-1)/M of the makespan to ~(S-1)/(vM) while every
+        # cross-stage edge still flows ring-forward.  Each candidate depth
+        # is costed with the event simulation — the same model the replay
+        # charges — and the best kept (ties prefer contiguous v=1, which
+        # also minimizes cross-slice crossings).  Deep interleave cut the
+        # 5k-task Llama probe's pipeline makespan from 2.7x to 1.8x of
+        # round-robin (ICI_r05; VERDICT r4 next #3).  An explicit
+        # ``n_stages`` skips the sweep (one stage per device, as before).
+        vmax = (
+            1 if self.n_stages
+            else max(1, min(4, -(-len(groups) // max(n_dev, 1))))
+        )
+        speeds = {d.node_id: d.compute_speed for d in devices}
+        slices = {d.node_id: d.slice_id for d in devices}
+        bounds = None
+        best_cost = None
+        best_map: Optional[Dict[str, int]] = None
+        for v in range(1, vmax + 1):
+            # a devices list repeated v times makes plan_stages' per-stage
+            # cap lookup (devices[s-1]) index cyclically — stage s sees
+            # device (s-1) % n_dev's budget
+            cand_bounds = self.plan_stages(
+                graph, devices * v, stats, reserved * v
+            )
+            if cand_bounds is None:
+                continue
+            cand_map = dict(stage_of)
+            for s in range(len(cand_bounds) - 1):
+                for i in range(cand_bounds[s], cand_bounds[s + 1]):
+                    cand_map[groups[i]] = s % n_dev
+            if v > 1 and not self._fits_per_device(
+                graph, devices, all_groups, all_gparams, all_activ,
+                cand_map,
+            ):
+                continue  # multi-stage union exceeds a device's budget
+            placement = {
+                tid: devices[cand_map[graph[tid].group or tid]].node_id
+                for tid in graph.topo_order
+                if (graph[tid].group or tid) in cand_map
+            }
+            _, cost, _ = simulate_placement(
+                graph, placement, speeds, self.link, slices
+            )
+            if best_cost is None or cost < best_cost:
+                bounds, best_cost, best_map = cand_bounds, cost, cand_map
+
         if bounds is not None:
-            for s in range(len(bounds) - 1):
-                for i in range(bounds[s], bounds[s + 1]):
-                    stage_of[groups[i]] = s
+            stage_of.update(best_map)
             # load-aware repack of the parked groups now that stage loads
             # are known (skipped when the weight-tied tail was co-located:
             # moving its shard would break the tie locality it bought)

@@ -58,6 +58,12 @@ class DFSScheduler(BaseScheduler):
 
     Each round sorts ready tasks deepest-first (DAG depth from roots) and
     assigns each to the fitting node with the most available memory.
+
+    Divergence from the reference: candidates pass the load-band filter
+    (``BaseScheduler.load_band``) first.  When params are shared across
+    microbatches, available memory barely moves within a round, so the
+    reference rule dumps an entire ready set on one node (3x round-robin
+    on the 5k-task Llama probe).
     """
 
     name = "dfs"
@@ -72,7 +78,8 @@ class DFSScheduler(BaseScheduler):
             fitting = [n for n in run.cluster if self.can_fit(run, task, n)]
             if not fitting:
                 return None
-            return max(fitting, key=lambda n: n.available_memory)
+            return max(self.load_band(run, task, fitting),
+                       key=lambda n: n.available_memory)
 
         self._round_loop(run, order, pick)
 
@@ -81,9 +88,15 @@ class GreedyScheduler(BaseScheduler):
     """Parameter-locality greedy (reference ``schedulers.py:211-296``).
 
     Picks the node minimizing the number of params that would need loading,
-    tie-broken by most available memory.  (The reference also defines a
-    chain-identification helper its ``schedule`` never calls — SURVEY.md §2;
-    we implement the code's actual behavior.)
+    tie-broken by most available memory (the reference tie-break).  (The
+    reference also defines a chain-identification helper its ``schedule``
+    never calls — SURVEY.md §2; we implement the code's actual behavior.)
+
+    Divergence from the reference: the load-band filter
+    (``BaseScheduler.load_band``) bounds concentration.  Pure param-overlap
+    scoring sends every microbatch of a layer to the node that cached the
+    layer's weights first, forever — 11x worse than round-robin on the
+    5k-task Llama probe (ICI_r04.json; VERDICT r4 next #3).
     """
 
     name = "greedy"
@@ -93,10 +106,9 @@ class GreedyScheduler(BaseScheduler):
             return ready
 
         def pick(run, task, ready_ids) -> Optional[DeviceState]:
+            fitting = [n for n in run.cluster if self.can_fit(run, task, n)]
             best, best_key = None, None
-            for node in run.cluster:
-                if not self.can_fit(run, task, node):
-                    continue
+            for node in self.load_band(run, task, fitting):
                 to_load = sum(
                     1 for p in task.params_needed if p not in node.cached_params
                 )
@@ -113,6 +125,12 @@ class CriticalPathScheduler(BaseScheduler):
 
     Ready tasks sorted by longest downstream critical-path length (own time
     + max over dependents), assigned to the **fastest** fitting node.
+
+    Divergence from the reference: the load-band filter
+    (``BaseScheduler.load_band``) applies before the speed pick — without
+    it, equal-speed clusters degrade to the dfs dump-on-one-node pathology
+    (3x round-robin, and memory exhaustion from param duplication, on the
+    5k-task Llama probe).
     """
 
     name = "critical"
@@ -127,7 +145,8 @@ class CriticalPathScheduler(BaseScheduler):
             fitting = [n for n in run.cluster if self.can_fit(run, task, n)]
             if not fitting:
                 return None
-            return max(fitting, key=lambda n: (n.compute_speed, n.available_memory))
+            return max(self.load_band(run, task, fitting),
+                       key=lambda n: (n.compute_speed, n.available_memory))
 
         self._round_loop(run, order, pick)
 
@@ -206,11 +225,23 @@ class MRUScheduler(BaseScheduler):
             return sorted(ready, key=lambda t: -pending_dependents[t.task_id])
 
         def pick(run, task, ready_ids) -> Optional[DeviceState]:
+            # candidates = nodes that fit (possibly after eviction); the
+            # load band applies on top — the overlap bonus otherwise
+            # concentrates shared-param work just like greedy (8x
+            # round-robin on the 5k-task Llama probe, VERDICT r4 next #3)
+            candidates = [
+                (node, plan) for node in run.cluster
+                if (plan := eviction_plan(run, task, node, ready_ids))
+                is not None
+            ]
+            eligible = {
+                n.node_id
+                for n in self.load_band(run, task, [n for n, _ in candidates])
+            }
             best, best_score, best_plan = None, None, None
-            for node in run.cluster:
-                plan = eviction_plan(run, task, node, ready_ids)
-                if plan is None:
-                    continue  # cannot fit even after eviction
+            for node, plan in candidates:
+                if node.node_id not in eligible:
+                    continue
                 overlap = len(task.params_needed & node.cached_params)
                 # Reference conditional scoring (schedulers.py:487-493):
                 # a node that fits WITHOUT eviction earns its available

@@ -52,7 +52,27 @@ def test_watchdog_times_out_then_cpu_fallback(bench_mod, monkeypatch, capsys):
 
     def fake_run(cmd, env=None, stdout=None, timeout=None):
         calls.append(env)
-        if len(calls) == 1:  # the TPU attempt hangs
+        if len(calls) <= 2:  # both TPU attempts (initial + retry) hang
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        return _result((line + "\n").encode())
+
+    monkeypatch.setattr(bench_mod.subprocess_module, "run", fake_run)
+    assert bench_mod.run_with_watchdog("small") == 0
+    assert capsys.readouterr().out.strip() == line
+    assert len(calls) == 3
+    assert calls[1]["DLS_BENCH_LIGHT"] == "1"
+    assert calls[2]["DLS_PLATFORM"] == "cpu"
+
+
+def test_watchdog_tpu_retry_recovers(bench_mod, monkeypatch, capsys):
+    """A transient wedge on the first TPU attempt must be retried on the
+    TPU path (light reps) — not surrendered straight to CPU."""
+    line = json.dumps({"metric": "m", "value": 3.0, "fallback": False})
+    calls = []
+
+    def fake_run(cmd, env=None, stdout=None, timeout=None):
+        calls.append((env, timeout))
+        if len(calls) == 1:
             raise subprocess.TimeoutExpired(cmd, timeout)
         return _result((line + "\n").encode())
 
@@ -60,12 +80,16 @@ def test_watchdog_times_out_then_cpu_fallback(bench_mod, monkeypatch, capsys):
     assert bench_mod.run_with_watchdog("small") == 0
     assert capsys.readouterr().out.strip() == line
     assert len(calls) == 2
-    assert calls[1]["DLS_PLATFORM"] == "cpu"
+    env2, timeout2 = calls[1]
+    assert env2["DLS_BENCH_LIGHT"] == "1"
+    assert env2.get("DLS_PLATFORM") != "cpu"
+    assert timeout2 < calls[0][1]  # retry runs on a shorter budget
 
 
 def test_watchdog_rejects_garbage_and_failure(bench_mod, monkeypatch):
     attempts = iter([
         _result(b"not json\n"),            # bad stdout
+        _result(b"still not json\n"),      # TPU retry: bad stdout again
         _result(b"", returncode=3),        # CPU fallback crashes too
     ])
 
@@ -92,6 +116,39 @@ def test_child_env_skips_watchdog():
     assert r.returncode == 1
     assert b"WATCHDOG" in r.stderr
     assert not r.stdout.strip()
+
+
+def test_promote_snapshot_headline():
+    from distributed_llm_scheduler_tpu.eval.benchlib import (
+        promote_snapshot_headline,
+    )
+
+    degraded = {
+        "metric": "m_tpu_cached", "value": 39.4, "fallback": True,
+        "last_measured": {"stub": 1},
+    }
+    snap = {
+        "measured_at": "2026-07-31T11:25:35+00:00", "age_days": 0.5,
+        "result": {"metric": "m", "value": 40.7, "fallback": False,
+                   "mfu_segmented": 0.47},
+    }
+    out = promote_snapshot_headline(degraded, snap, max_age_days=2.0)
+    # the headline is the measured TPU line, honestly stamped
+    assert out["value"] == 40.7 and out["mfu_segmented"] == 0.47
+    assert out["fallback"] is True
+    assert out["headline_source"].startswith("last_measured_tpu")
+    assert out["last_measured"] is snap
+    # the degraded line survives whole (minus the nested snapshot)
+    assert out["degraded_line"]["value"] == 39.4
+    assert "last_measured" not in out["degraded_line"]
+    # a stale snapshot must NOT be promoted to the headline
+    old = dict(snap, age_days=9.0)
+    assert promote_snapshot_headline(degraded, old, max_age_days=2.0) is None
+    unstamped = {k: v for k, v in snap.items() if k != "age_days"}
+    assert (
+        promote_snapshot_headline(degraded, unstamped, max_age_days=2.0)
+        is None
+    )
 
 
 def test_watchdog_skips_duplicate_cpu_attempt(bench_mod, monkeypatch):
