@@ -67,7 +67,8 @@ def run_with_watchdog(config_name: str) -> int:
     me = os.path.abspath(__file__)
 
     def attempt(extra_env, attempt_budget=None):
-        attempt_budget = attempt_budget or budget
+        if attempt_budget is None:
+            attempt_budget = budget
         env = {**os.environ, "DLS_BENCH_NO_WATCHDOG": "1", **extra_env}
         try:
             r = subprocess_module.run(

@@ -211,12 +211,24 @@ void round_loop(Run& run, OrderFn order_fn, PickFn pick_fn) {
 // compute time — mirroring the Python early return — or when nothing fits.
 constexpr double LOAD_BAND_FACTOR = 2.0;
 
-double band_threshold(Run& r, int t) {
+// Fill `fit` with can_fit per node (one scan, shared between the band
+// threshold and the selection loop in dfs/greedy/critical).
+void fit_mask(Run& r, int t, std::vector<uint8_t>& fit) {
+  fit.resize(r.g.n_nodes);
+  for (int node = 0; node < r.g.n_nodes; ++node)
+    fit[node] = r.can_fit(t, node);
+}
+
+// One copy of the band formula, over a caller-supplied candidate mask
+// (can_fit for dfs/greedy/critical, eviction-feasibility for MRU) — the
+// mask also lets picks reuse their fit scan instead of running it twice.
+double band_threshold_masked(const Run& r, int t,
+                             const std::vector<uint8_t>& candidate) {
   if (r.g.task_time[t] <= 0.0)
     return std::numeric_limits<double>::infinity();
   double min_busy = std::numeric_limits<double>::infinity();
   for (int node = 0; node < r.g.n_nodes; ++node)
-    if (r.can_fit(t, node)) min_busy = std::min(min_busy, r.busy[node]);
+    if (candidate[node]) min_busy = std::min(min_busy, r.busy[node]);
   if (!std::isfinite(min_busy)) return min_busy;
   return min_busy + LOAD_BAND_FACTOR * r.g.task_time[t] + 1e-12;
 }
@@ -255,10 +267,12 @@ void run_dfs(Run& run) {
                          [&](int a, int b) { return depth[a] > depth[b]; });
       },
       [](Run& r, int t, const std::vector<int32_t>&) -> int {
-        double thresh = band_threshold(r, t);
+        static thread_local std::vector<uint8_t> fit;
+        fit_mask(r, t, fit);
+        double thresh = band_threshold_masked(r, t, fit);
         int best = -1;  // most available memory; first max kept on ties
         for (int node = 0; node < r.g.n_nodes; ++node)
-          if (r.can_fit(t, node) && r.busy[node] <= thresh &&
+          if (fit[node] && r.busy[node] <= thresh &&
               (best < 0 || r.avail[node] > r.avail[best]))
             best = node;
         return best;
@@ -270,10 +284,12 @@ void run_greedy(Run& run) {
       run, [](Run&, std::vector<int32_t>&) {},
       [](Run& r, int t, const std::vector<int32_t>&) -> int {
         // min (params-to-load, -available); first best kept on ties
-        double thresh = band_threshold(r, t);
+        static thread_local std::vector<uint8_t> fit;
+        fit_mask(r, t, fit);
+        double thresh = band_threshold_masked(r, t, fit);
         int best = -1, best_load = 0;
         for (int node = 0; node < r.g.n_nodes; ++node) {
-          if (!r.can_fit(t, node) || r.busy[node] > thresh) continue;
+          if (!fit[node] || r.busy[node] > thresh) continue;
           int to_load = 0;
           for (int k = r.g.par_off[t]; k < r.g.par_off[t + 1]; ++k)
             if (!r.is_cached(node, r.g.par_ids[k])) ++to_load;
@@ -308,10 +324,12 @@ void run_critical(Run& run) {
       },
       [](Run& r, int t, const std::vector<int32_t>&) -> int {
         // fastest fitting node, tie-broken by available memory; first max
-        double thresh = band_threshold(r, t);
+        static thread_local std::vector<uint8_t> fit;
+        fit_mask(r, t, fit);
+        double thresh = band_threshold_masked(r, t, fit);
         int best = -1;
         for (int node = 0; node < r.g.n_nodes; ++node) {
-          if (!r.can_fit(t, node) || r.busy[node] > thresh) continue;
+          if (!fit[node] || r.busy[node] > thresh) continue;
           if (best < 0 || r.g.node_speed[node] > r.g.node_speed[best] ||
               (r.g.node_speed[node] == r.g.node_speed[best] &&
                r.avail[node] > r.avail[best]))
@@ -410,15 +428,12 @@ void run_mru(Run& run) {
         // band filter, then scoring — plans are pure, so precomputing
         // them is behavior-identical)
         std::vector<Plan> plans(g.n_nodes);
-        double min_busy = std::numeric_limits<double>::infinity();
+        std::vector<uint8_t> feasible(g.n_nodes);
         for (int node = 0; node < g.n_nodes; ++node) {
           plans[node] = eviction_plan(r, t, node, ordered);
-          if (plans[node].ok) min_busy = std::min(min_busy, r.busy[node]);
+          feasible[node] = plans[node].ok;
         }
-        double thresh =
-            (g.task_time[t] <= 0.0 || !std::isfinite(min_busy))
-                ? std::numeric_limits<double>::infinity()
-                : min_busy + LOAD_BAND_FACTOR * g.task_time[t] + 1e-12;
+        double thresh = band_threshold_masked(r, t, feasible);
         int best = -1;
         double best_score = 0.0;
         Plan best_plan{false, {}};
@@ -919,9 +934,7 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
     // interleave depth with the event simulation, keep the best (strictly
     // lower makespan; ties prefer the shallower, more contiguous plan)
     int vmax = std::max(1, std::min(4, (n + n_dev - 1) / n_dev));
-    bool have_best = false;
-    double best_cost = 0.0;
-    std::vector<int32_t> best_stage;
+    std::vector<std::vector<int32_t>> candidates;
     for (int v = 1; v <= vmax; ++v) {
       std::vector<int32_t> bounds = plan(std::min(n, v * n_dev));
       if (bounds.empty()) continue;
@@ -951,17 +964,26 @@ void run_pipeline(Run& run, const double* link3, const int32_t* group_ids) {
         }
         if (!ok) continue;
       }
-      std::vector<int32_t> cassign(g.n_tasks, -1);
-      for (int t = 0; t < g.n_tasks; ++t) cassign[t] = cand[group_ids[t]];
-      EventOrder eo = event_order(g, cassign, topo, link3);
-      if (!have_best || eo.makespan < best_cost) {
-        have_best = true;
-        best_cost = eo.makespan;
-        best_stage = cand;
-      }
+      candidates.push_back(std::move(cand));
     }
-    if (have_best) {
-      stage_of_group = best_stage;
+    if (!candidates.empty()) {
+      if (candidates.size() == 1) {
+        stage_of_group = candidates[0];  // nothing to compare; skip the sim
+      } else {
+        double best_cost = 0.0;
+        int best_i = -1;
+        for (size_t ci = 0; ci < candidates.size(); ++ci) {
+          std::vector<int32_t> cassign(g.n_tasks, -1);
+          for (int t = 0; t < g.n_tasks; ++t)
+            cassign[t] = candidates[ci][group_ids[t]];
+          EventOrder eo = event_order(g, cassign, topo, link3);
+          if (best_i < 0 || eo.makespan < best_cost) {
+            best_i = (int)ci;
+            best_cost = eo.makespan;
+          }
+        }
+        stage_of_group = candidates[best_i];
+      }
       // load-aware repack of parked groups (sched/pipeline.py
       // _rebalance_parked): greedily move them onto devices minimizing
       // the resulting param-union load, adopt only on strict improvement

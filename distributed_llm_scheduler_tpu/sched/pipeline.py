@@ -378,9 +378,7 @@ class PipelineStageScheduler(BaseScheduler):
         )
         speeds = {d.node_id: d.compute_speed for d in devices}
         slices = {d.node_id: d.slice_id for d in devices}
-        bounds = None
-        best_cost = None
-        best_map: Optional[Dict[str, int]] = None
+        candidates: List[Dict[str, int]] = []
         for v in range(1, vmax + 1):
             # a devices list repeated v times makes plan_stages' per-stage
             # cap lookup (devices[s-1]) index cyclically — stage s sees
@@ -399,18 +397,26 @@ class PipelineStageScheduler(BaseScheduler):
                 cand_map,
             ):
                 continue  # multi-stage union exceeds a device's budget
-            placement = {
-                tid: devices[cand_map[graph[tid].group or tid]].node_id
-                for tid in graph.topo_order
-                if (graph[tid].group or tid) in cand_map
-            }
-            _, cost, _ = simulate_placement(
-                graph, placement, speeds, self.link, slices
-            )
-            if best_cost is None or cost < best_cost:
-                bounds, best_cost, best_map = cand_bounds, cost, cand_map
+            candidates.append(cand_map)
 
-        if bounds is not None:
+        best_map: Optional[Dict[str, int]] = None
+        if len(candidates) == 1:
+            best_map = candidates[0]  # nothing to compare; skip the sim
+        else:
+            best_cost = None
+            for cand_map in candidates:
+                placement = {
+                    tid: devices[cand_map[graph[tid].group or tid]].node_id
+                    for tid in graph.topo_order
+                    if (graph[tid].group or tid) in cand_map
+                }
+                _, cost, _ = simulate_placement(
+                    graph, placement, speeds, self.link, slices
+                )
+                if best_cost is None or cost < best_cost:
+                    best_cost, best_map = cost, cand_map
+
+        if best_map is not None:
             stage_of.update(best_map)
             # load-aware repack of the parked groups now that stage loads
             # are known (skipped when the weight-tied tail was co-located:
