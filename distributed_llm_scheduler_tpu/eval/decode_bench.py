@@ -512,6 +512,107 @@ def measure_decode_dag(
               + traceback.format_exc(), file=sys.stderr)
         step_seg = None
 
+    # on-device K-step loop (backends/decode_loop.py): the scheduled step
+    # DAG composed into one program, lax.scan over K tokens with donated
+    # caches — ONE dispatch + ONE (B, K) int32 readback per K tokens, so
+    # the 71 ms/token host round-trip that owned tok_s_end_to_end is paid
+    # once per K (VERDICT r4 next #6).  Fresh graphs at a longer max_len:
+    # the host-driven run above consumed its whole cache horizon.
+    looped = None
+    try:
+        from ..backends.decode_loop import (
+            build_decode_loop,
+            split_cache_params,
+        )
+
+        from ..models.decode import _position_limit
+
+        K = 64
+        limit = _position_limit(config)
+        if limit is not None:  # tiny configs: shrink with the horizon
+            K = min(K, (limit - prompt_len - 1) // 2)
+        if K < 2:
+            raise ValueError(
+                f"position horizon too short for a looped window "
+                f"(limit {limit}, prompt {prompt_len})"
+            )
+        max_len2 = prompt_len + 1 + 2 * K
+        pdag2 = build_decode_dag_any(
+            config, batch=batch, step_len=prompt_len, max_len=max_len2
+        )
+        params2 = dict(params)
+        for i in range(n_layers):
+            for kind in ("k", "v"):
+                params2[f"cache_{kind}_{i}"] = jnp.zeros(
+                    (batch, nkv, max_len2, hd), config.dtype
+                )
+        psched2 = get_scheduler(policy).schedule(pdag2.graph, cluster)
+        rep2 = backend.execute(
+            pdag2.graph, psched2, params2,
+            decode_inputs(ids, 0, max_len=max_len2), keep_outputs=True,
+        )
+        params2 = apply_cache_updates(
+            params2, rep2.task_outputs, config, pos=0
+        )
+        # argmax on device; only B int32s ever cross the link (the host-
+        # driven loop above documents why full-logit readback is avoided)
+        tok0 = jnp.argmax(
+            rep2.output[:, -1, :], axis=-1
+        ).astype(jnp.int32)[:, None]
+        ddag2 = build_decode_dag_any(
+            config, batch=batch, step_len=1, max_len=max_len2
+        )
+        dsched2 = get_scheduler(policy).schedule(ddag2.graph, cluster)
+        weights2, caches2 = split_cache_params(params2)
+        loop = build_decode_loop(ddag2.graph, dsched2, config, steps=K)
+        # first window compiles and advances to pos P+K; its end state is
+        # the pristine mid-point every timed window restarts from
+        toks1, caches_mid = loop(
+            weights2, caches2, tok0, jnp.int32(prompt_len)
+        )
+        toks1_np = np.asarray(toks1)
+        mid = {k: jnp.array(v) for k, v in caches_mid.items()}
+        tok_mid = jnp.asarray(toks1_np[:, -1:])
+
+        def timed_window():
+            # cache copies made OFF the clock; the window is one dispatch
+            # + one token readback, the real steady-state loop iteration
+            c = {k: jnp.array(v) for k, v in mid.items()}
+            for v in c.values():
+                v.block_until_ready()
+            t0 = _time.perf_counter()
+            toks, _ = loop(
+                weights2, c, tok_mid, jnp.int32(prompt_len + K)
+            )
+            toks_np = np.asarray(toks)  # the one readback
+            return _time.perf_counter() - t0, toks_np
+
+        walls = [timed_window() for _ in range(3)]
+        wall, toks2_np = min(walls, key=lambda w: w[0])
+        # free-running agreement vs the whole-program greedy stream over
+        # the same horizon (exact on the f32 CPU mesh —
+        # tests/test_decode_dag.py; bf16-on-chip argmax near-ties can
+        # diverge and then cascade, which this fraction discloses)
+        full2 = np.asarray(mod.generate(
+            params, ids, config, max_new_tokens=2 * K + 1,
+            max_len=max_len2,
+        ))[:, prompt_len:]
+        ours = np.concatenate([np.asarray(tok0), toks1_np, toks2_np], axis=1)
+        looped = {
+            "steps_per_dispatch": K,
+            "tok_s": round(batch * K / wall, 2),
+            "ms_per_token": round(wall * 1e3 / K, 4),
+            "dispatch_plus_readback_ms": round(wall * 1e3, 2),
+            "token_agreement_vs_whole_program": round(
+                float((ours == full2).mean()), 4
+            ),
+        }
+    except Exception:
+        import traceback
+
+        print("decode_dag: WARNING looped decode failed:\n"
+              + traceback.format_exc(), file=sys.stderr)
+
     out = {
         "family": _family_of(config),
         "platform": dev.platform,
@@ -537,6 +638,7 @@ def measure_decode_dag(
         ),
         "host_rtt_ms": round(_fence_rtt(dev) * 1e3, 3),
         "n_timed_steps": n_timed,
+        "looped": looped,
     }
     roof = decode_roofline(config, batch, max_len, dev.platform)
     if roof is not None and step_seg is not None:

@@ -273,3 +273,76 @@ def test_measure_decode_dag_bench_leg():
     assert r["step_ms_per_task"] > 0
     assert r["step_ms_segmented"] is not None and r["step_ms_segmented"] > 0
     assert r["tok_s_end_to_end"] is not None and r["n_timed_steps"] == 2
+    # the K-step on-device loop leg: present, f32-exact vs whole-program
+    assert r["looped"] is not None
+    assert r["looped"]["token_agreement_vs_whole_program"] == 1.0
+    assert r["looped"]["tok_s"] > 0
+
+
+def test_decode_loop_token_exact_and_chains():
+    """The on-device K-step loop (backends/decode_loop.py) must reproduce
+    models/decode.generate greedy tokens exactly from a DAG-path prefill,
+    and chaining two loop calls (donated caches fed back) must equal one
+    longer loop."""
+    from distributed_llm_scheduler_tpu.backends.decode_loop import (
+        build_decode_loop,
+        split_cache_params,
+    )
+
+    ids = _prompt()
+    model_params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    n_new = 6
+    max_len = P + n_new
+    want = gpt2.generate(model_params, ids, CFG, max_new_tokens=n_new)
+
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    dag = build_decode_dag(CFG, batch=B, step_len=P, max_len=max_len)
+    params = dag.init_params()
+    params.update(model_params)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    rep = backend.execute(
+        dag.graph, sched, params, decode_inputs(ids, 0), keep_outputs=True
+    )
+    params = apply_cache_updates(params, rep.task_outputs, CFG, pos=0)
+    tok0 = jnp.argmax(np.asarray(rep.output)[:, -1, :], axis=-1).astype(
+        jnp.int32
+    )[:, None]
+
+    ddag = build_decode_dag(CFG, batch=B, step_len=1, max_len=max_len)
+    dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
+    weights, caches = split_cache_params(params)
+
+    def fresh_caches():
+        # donation consumes the buffers — each loop launch needs its own
+        return {k: jnp.array(v) for k, v in caches.items()}
+
+    # one loop over the remaining n_new - 1 tokens
+    loop = build_decode_loop(ddag.graph, dsched, CFG, steps=n_new - 1)
+    toks, _ = loop(weights, fresh_caches(), tok0, jnp.int32(P))
+    got = jnp.concatenate([tok0, toks], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(want[:, P:P + n_new]), np.asarray(got)
+    )
+
+    # two chained shorter loops == the one long loop
+    k1 = 2
+    loop_a = build_decode_loop(ddag.graph, dsched, CFG, steps=k1)
+    loop_b = build_decode_loop(ddag.graph, dsched, CFG, steps=n_new - 1 - k1)
+    t1, c1 = loop_a(weights, fresh_caches(), tok0, jnp.int32(P))
+    t2, _ = loop_b(weights, c1, t1[:, -1:], jnp.int32(P + k1))
+    chained = jnp.concatenate([tok0, t1, t2], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(chained))
+
+
+def test_decode_loop_rejects_multi_node_placement():
+    from distributed_llm_scheduler_tpu.backends.decode_loop import (
+        compose_step_fn,
+    )
+    from distributed_llm_scheduler_tpu.core.cluster import DeviceState
+
+    ddag = build_decode_dag(CFG, batch=B, step_len=1, max_len=M)
+    cluster = Cluster([DeviceState(f"n{i}", 64.0) for i in range(2)])
+    sched = get_scheduler("roundrobin").schedule(ddag.graph, cluster)
+    with pytest.raises(ValueError, match="single-node"):
+        compose_step_fn(ddag.graph, sched, CFG)
