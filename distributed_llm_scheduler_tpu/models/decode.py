@@ -145,6 +145,10 @@ def cached_attention(
     """
     B, Hq, Tn, hd = q.shape
     Hkv, M = k_cache.shape[1], k_cache.shape[2]
+    if Tn == 1:
+        return _decode_attention_natural(
+            q, k_cache, v_cache, pos_start, sm_scale, k_scale, v_scale
+        )
     if Hq != Hkv:
         group = Hq // Hkv
         k_cache = jnp.repeat(k_cache, group, axis=1)
@@ -171,6 +175,65 @@ def cached_attention(
     return jnp.einsum(
         "bhqk,bhkd->bhqd",
         probs.astype(out_dtype), v_cache.astype(out_dtype),
+    )
+
+
+def _decode_attention_natural(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+    sm_scale: float,
+    k_scale: Optional[jax.Array],
+    v_scale: Optional[jax.Array],
+) -> jax.Array:
+    """Single-token cached attention in MXU-natural orientation.
+
+    The prefill-orientation einsum (``bhqd,bhkd->bhqk``) at T_new = 1
+    forces XLA to transpose the K cache every step — measured 120 GB/s
+    effective on the v5e, ~1/5 of what the chip streams at these shapes.
+    Computing scores as ``K @ q`` instead ((B, Hkv, M, G) with M on
+    sublanes, exactly the cache's storage layout) runs the identical
+    math at 576 GB/s (0.81 -> 0.29 ms/step on the 12-layer flagship
+    attribution; DECODE_r05).  A Pallas per-layer kernel was tried first
+    and LOST: ~66 us fixed cost per pallas_call x 12 sequential layers
+    swamps any in-kernel win — the right decode kernel here is the one
+    XLA already has, fed shapes in its preferred orientation.
+
+    GQA comes free: the query group joins the G axis (``bhgd`` below),
+    so K/V stream ONCE per KV head — the prefill path's ``jnp.repeat``
+    reads them ``group`` times.  int8 scale folding is unchanged in
+    algebra, just applied along the natural axes.
+    """
+    B, Hq, _, hd = q.shape
+    Hkv, M = k_cache.shape[1], k_cache.shape[2]
+    G = Hq // Hkv
+    qg = (q * sm_scale).reshape(B, Hkv, G, hd)
+    # scores (B, Hkv, M, G): contract hd (lanes), batch (B, Hkv) — both
+    # operands read in storage order, no transpose materialized
+    s = jax.lax.dot_general(
+        k_cache.astype(qg.dtype), qg,
+        (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )
+    if k_scale is not None:
+        s = s * k_scale.astype(s.dtype)  # (B, Hkv, M, 1) broadcasts over G
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(rows <= pos, s, jnp.finfo(s.dtype).min)
+    m = s.max(axis=2, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=2, keepdims=True)
+    if v_scale is not None:
+        p = p * v_scale.astype(p.dtype)
+    out_dtype = q.dtype
+    # out (B, Hkv, G, hd): contract M (sublanes of both), batch (B, Hkv)
+    o = jax.lax.dot_general(
+        p.astype(out_dtype), v_cache.astype(out_dtype),
+        (((2,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )
+    return (o / l.reshape(B, Hkv, G, 1)).astype(out_dtype).reshape(
+        B, Hq, 1, hd
     )
 
 

@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <cstring>
 #include <limits>
+#include <map>
 #include <queue>
 #include <utility>
 #include <vector>
@@ -92,6 +93,9 @@ struct Run {
   std::vector<uint8_t> cached;        // [n_nodes * n_params]
   std::vector<int32_t> completed_on;  // [n_nodes] completed-task count
   std::vector<double> busy;           // [n_nodes] compute backlog seconds
+  std::vector<int32_t> pset_id;       // [n_tasks] param-set identity
+  int n_psets = 0;
+  std::vector<int32_t> colocated;     // [n_nodes * n_psets] same-set count
   std::vector<uint8_t> pending, completed, failed;  // [n_tasks]
   std::vector<int32_t> assign;        // [n_tasks] node or -1
   std::vector<int32_t> order;         // assignment order (task ids)
@@ -102,6 +106,23 @@ struct Run {
     cached.assign((size_t)g.n_nodes * g.n_params, 0);
     completed_on.assign(g.n_nodes, 0);
     busy.assign(g.n_nodes, 0.0);
+    // param-set identity: tasks with the same sorted param-id sequence
+    // share an id (SchedulerRun.sorted_params keys; par ids are already
+    // in name order on the wire)
+    pset_id.assign(g.n_tasks, -1);
+    {
+      std::map<std::vector<int32_t>, int32_t> ids;
+      for (int t = 0; t < g.n_tasks; ++t) {
+        std::vector<int32_t> key(g.par_ids + g.par_off[t],
+                                 g.par_ids + g.par_off[t + 1]);
+        auto it = ids.find(key);
+        if (it == ids.end())
+          it = ids.emplace(std::move(key), (int32_t)ids.size()).first;
+        pset_id[t] = it->second;
+      }
+      n_psets = (int)ids.size();
+    }
+    colocated.assign((size_t)g.n_nodes * n_psets, 0);
     pending.assign(g.n_tasks, 1);
     completed.assign(g.n_tasks, 0);
     failed.assign(g.n_tasks, 0);
@@ -147,6 +168,7 @@ struct Run {
     pending[t] = 0;
     --n_pending;
     busy[node] += g.task_time[t] / g.node_speed[node];
+    colocated[(size_t)node * n_psets + pset_id[t]]++;
     // complete_task
     avail[node] += g.task_mem[t];
     completed[t] = 1;
@@ -211,6 +233,12 @@ void round_loop(Run& run, OrderFn order_fn, PickFn pick_fn) {
 // compute time — mirroring the Python early return — or when nothing fits.
 constexpr double LOAD_BAND_FACTOR = 2.0;
 
+constexpr double LOAD_BAND_FULL_HIT_FACTOR = 4.0;
+constexpr int LOAD_BAND_FULL_HIT_SIBLINGS = 2;
+// GreedyScheduler.LOAD_BAND_FACTOR: greedy's min-to-load key always takes
+// the most-cached in-band node, so its base band is tighter
+constexpr double GREEDY_LOAD_BAND_FACTOR = 1.0;
+
 // Fill `fit` with can_fit per node (one scan, shared between the band
 // threshold and the selection loop in dfs/greedy/critical).
 void fit_mask(Run& r, int t, std::vector<uint8_t>& fit) {
@@ -219,18 +247,50 @@ void fit_mask(Run& r, int t, std::vector<uint8_t>& fit) {
     fit[node] = r.can_fit(t, node);
 }
 
-// One copy of the band formula, over a caller-supplied candidate mask
-// (can_fit for dfs/greedy/critical, eviction-feasibility for MRU) — the
-// mask also lets picks reuse their fit scan instead of running it twice.
-double band_threshold_masked(const Run& r, int t,
-                             const std::vector<uint8_t>& candidate) {
-  if (r.g.task_time[t] <= 0.0)
-    return std::numeric_limits<double>::infinity();
-  double min_busy = std::numeric_limits<double>::infinity();
+// Per-node band eligibility (BaseScheduler.load_band), one copy of the
+// formula over a caller-supplied candidate mask (can_fit for dfs/greedy/
+// critical, eviction-feasibility for MRU).  `base`/`hit` are the two
+// busy thresholds: `hit` (wider) applies only to nodes that already
+// cache every param the task needs — zero load bytes, so locality is
+// worth more there (expert-locality; see base.py).
+struct Band {
+  double base, hit;
+};
+
+Band band_thresholds_masked(const Run& r, int t,
+                            const std::vector<uint8_t>& candidate,
+                            double base_factor = LOAD_BAND_FACTOR) {
+  constexpr double INF = std::numeric_limits<double>::infinity();
+  if (r.g.task_time[t] <= 0.0) return {INF, INF};
+  double min_busy = INF;
   for (int node = 0; node < r.g.n_nodes; ++node)
     if (candidate[node]) min_busy = std::min(min_busy, r.busy[node]);
-  if (!std::isfinite(min_busy)) return min_busy;
-  return min_busy + LOAD_BAND_FACTOR * r.g.task_time[t] + 1e-12;
+  if (!std::isfinite(min_busy)) return {min_busy, min_busy};
+  return {min_busy + base_factor * r.g.task_time[t] + 1e-12,
+          min_busy + LOAD_BAND_FULL_HIT_FACTOR * r.g.task_time[t] + 1e-12};
+}
+
+bool full_hit(Run& r, int t, int node) {
+  for (int k = r.g.par_off[t]; k < r.g.par_off[t + 1]; ++k)
+    if (!r.is_cached(node, r.g.par_ids[k])) return false;
+  return true;
+}
+
+// The wider full-hit band is capped at SIBLINGS same-param-set tasks per
+// node (SchedulerRun.colocated on the Python side); param-less tasks save
+// no bytes and never qualify (BaseScheduler.load_band).
+bool band_eligible(Run& r, int t, int node, const Band& band,
+                   int known_full_hit = -1) {
+  if (r.busy[node] <= band.base) return true;
+  if (r.busy[node] > band.hit) return false;
+  if (r.g.par_off[t] == r.g.par_off[t + 1]) return false;
+  // callers that already counted uncached params (greedy's to_load,
+  // MRU's overlap) pass the verdict in rather than re-scanning
+  bool fh = known_full_hit >= 0 ? (known_full_hit != 0)
+                                : full_hit(r, t, node);
+  if (!fh) return false;
+  return r.colocated[(size_t)node * r.n_psets + r.pset_id[t]] <
+         LOAD_BAND_FULL_HIT_SIBLINGS;
 }
 
 void run_roundrobin(Run& run) {
@@ -269,10 +329,10 @@ void run_dfs(Run& run) {
       [](Run& r, int t, const std::vector<int32_t>&) -> int {
         static thread_local std::vector<uint8_t> fit;
         fit_mask(r, t, fit);
-        double thresh = band_threshold_masked(r, t, fit);
+        Band band = band_thresholds_masked(r, t, fit);
         int best = -1;  // most available memory; first max kept on ties
         for (int node = 0; node < r.g.n_nodes; ++node)
-          if (fit[node] && r.busy[node] <= thresh &&
+          if (fit[node] && band_eligible(r, t, node, band) &&
               (best < 0 || r.avail[node] > r.avail[best]))
             best = node;
         return best;
@@ -286,13 +346,17 @@ void run_greedy(Run& run) {
         // min (params-to-load, -available); first best kept on ties
         static thread_local std::vector<uint8_t> fit;
         fit_mask(r, t, fit);
-        double thresh = band_threshold_masked(r, t, fit);
+        Band band = band_thresholds_masked(r, t, fit,
+                                           GREEDY_LOAD_BAND_FACTOR);
         int best = -1, best_load = 0;
         for (int node = 0; node < r.g.n_nodes; ++node) {
-          if (!fit[node] || r.busy[node] > thresh) continue;
+          if (!fit[node]) continue;
           int to_load = 0;
           for (int k = r.g.par_off[t]; k < r.g.par_off[t + 1]; ++k)
             if (!r.is_cached(node, r.g.par_ids[k])) ++to_load;
+          if (!band_eligible(r, t, node, band,
+                             /*known_full_hit=*/to_load == 0 ? 1 : 0))
+            continue;
           if (best < 0 || to_load < best_load ||
               (to_load == best_load && r.avail[node] > r.avail[best])) {
             best = node;
@@ -326,10 +390,10 @@ void run_critical(Run& run) {
         // fastest fitting node, tie-broken by available memory; first max
         static thread_local std::vector<uint8_t> fit;
         fit_mask(r, t, fit);
-        double thresh = band_threshold_masked(r, t, fit);
+        Band band = band_thresholds_masked(r, t, fit);
         int best = -1;
         for (int node = 0; node < r.g.n_nodes; ++node) {
-          if (!fit[node] || r.busy[node] > thresh) continue;
+          if (!fit[node] || !band_eligible(r, t, node, band)) continue;
           if (best < 0 || r.g.node_speed[node] > r.g.node_speed[best] ||
               (r.g.node_speed[node] == r.g.node_speed[best] &&
                r.avail[node] > r.avail[best]))
@@ -433,16 +497,20 @@ void run_mru(Run& run) {
           plans[node] = eviction_plan(r, t, node, ordered);
           feasible[node] = plans[node].ok;
         }
-        double thresh = band_threshold_masked(r, t, feasible);
+        Band band = band_thresholds_masked(r, t, feasible);
         int best = -1;
         double best_score = 0.0;
         Plan best_plan{false, {}};
         for (int node = 0; node < g.n_nodes; ++node) {
           Plan& plan = plans[node];
-          if (!plan.ok || r.busy[node] > thresh) continue;
+          if (!plan.ok) continue;
           int overlap = 0;
           for (int k = g.par_off[t]; k < g.par_off[t + 1]; ++k)
             if (r.is_cached(node, g.par_ids[k])) ++overlap;
+          int n_par = g.par_off[t + 1] - g.par_off[t];
+          if (!band_eligible(r, t, node, band,
+                             /*known_full_hit=*/overlap == n_par ? 1 : 0))
+            continue;
           // Reference conditional scoring: available memory only when the
           // task fits without eviction, the flat bonus only when eviction
           // is needed (mirrors policies.py MRU pick).

@@ -101,6 +101,13 @@ class GreedyScheduler(BaseScheduler):
 
     name = "greedy"
 
+    # tighter base band than the other policies: greedy's primary key
+    # (min params-to-load) ALWAYS takes the most-cached in-band node, so
+    # at the default width it concentrates 2.5x round-robin on the
+    # 5k-task probe; one task-time keeps it at 1.96x with the full-hit
+    # exception still carrying expert locality
+    LOAD_BAND_FACTOR = 1.0
+
     def run_policy(self, run: SchedulerRun) -> None:
         def order(run, ready):
             return ready
