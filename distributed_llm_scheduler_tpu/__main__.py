@@ -760,6 +760,7 @@ def cmd_rankcheck(args) -> int:
         policies=[p.strip() for p in args.policies.split(",") if p.strip()],
         measure_repeats=args.measure_repeats,
         reps=args.reps,
+        anchor_calibrate=args.anchor_calibrate,
         **kwargs,
     )
     print(json.dumps(report, indent=1))
@@ -927,6 +928,12 @@ def main(argv=None) -> int:
     p.add_argument("--measure-repeats", type=int, default=3)
     p.add_argument("--reps", type=int, default=1,
                    help="amortized repetitions per measured run")
+    p.add_argument("--anchor-calibrate", action="store_true",
+                   help="two-anchor in-situ calibration (busy-host "
+                        "compute scale + dispatcher-blocking staging "
+                        "rate) before predicting; anchors are in-sample, "
+                        "other policies and the ordering out-of-sample "
+                        "(eval/rankcheck.py)")
     p.add_argument("--stress", action="store_true",
                    help="use the transfer-stress DAG (frontend/stress_dag): "
                         "cheap compute, large cross-device activations — "

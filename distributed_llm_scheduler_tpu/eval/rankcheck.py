@@ -61,6 +61,7 @@ def run_rank_check(
     reps: int = 1,
     winner_rtol: float = 0.05,
     tie_rtol: float = 0.10,
+    anchor_calibrate: bool = False,
     log: Callable[[str], None] = lambda m: print(m, file=sys.stderr),
 ) -> Dict[str, Any]:
     """Schedule ``policies``, predict each placement's makespan with the
@@ -83,6 +84,23 @@ def run_rank_check(
     carries ``prediction_spread`` and ``prediction_is_tie`` so a vacuous
     pass is visible as such; the per-policy ratio band (see
     tests/test_linkmodel.py) still applies either way.
+
+    ``anchor_calibrate``: two-anchor in-situ calibration for the
+    compute-tied flagship regime.  The quiet-host microbenchmarks
+    (``calibrate``/``calibrate_link``) under-charge a BUSY host — the
+    staging memcpys and task compute compete with the mesh's worker
+    threads, so per-policy costs measured in isolation predict a near-tie
+    where reality spreads 15-40% (the r4 flagship leg: predicted spread
+    1.7%, measured 37%).  With this flag the check (a) scales task times
+    so the load-LIGHTEST policy's prediction matches its measurement,
+    then (b) fits the host staging rate (dispatcher-blocking serial
+    loads, ``SimulatedBackend(host_serial_loads=True)``) so the
+    load-HEAVIEST policy matches too, and re-predicts every policy with
+    the calibrated simulator.  The two anchors are in-sample by
+    construction (their ratios are ~1.0 and say nothing); every OTHER
+    policy's ratio and the full ordering are out-of-sample.  The report
+    discloses the anchors, both fitted constants, and the uncalibrated
+    predictions.
 
     Returns a JSON-shaped dict: per-policy predicted/measured seconds and
     ratio, predicted/measured orderings, Kendall tau, winner agreement.
@@ -120,6 +138,8 @@ def run_rank_check(
     backend = DeviceBackend(cluster)
 
     per_policy: Dict[str, Dict[str, float]] = {}
+    scheds: Dict[str, Any] = {}
+    load_gb: Dict[str, float] = {}
     for policy in policies:
         sched = get_scheduler(policy, link=link).schedule(graph, cluster)
         if sched.failed:
@@ -139,9 +159,114 @@ def run_rank_check(
             "measured_s": measured,
             "ratio": predicted / measured if measured > 0 else float("inf"),
         }
+        scheds[policy] = sched
+        # unique (node, param) staging bytes this placement causes
+        seen = set()
+        total = 0.0
+        for tid, nid in sched.placement.items():
+            for p in graph[tid].params_needed:
+                if (nid, p) not in seen:
+                    seen.add((nid, p))
+                    total += graph.param_size_gb(p)
+        load_gb[policy] = total
         log(f"rankcheck: {policy:10s} predicted {predicted*1e3:8.2f} ms "
             f"measured {measured*1e3:8.2f} ms "
-            f"(ratio {per_policy[policy]['ratio']:.2f})")
+            f"(ratio {per_policy[policy]['ratio']:.2f}; "
+            f"staging {total:.2f} GB)")
+
+    calibration: Optional[Dict[str, Any]] = None
+    if anchor_calibrate and (
+        len(per_policy) < 3
+        or min(load_gb.values()) == max(load_gb.values())
+    ):
+        log("rankcheck: anchor calibration SKIPPED (needs >= 3 complete "
+            "policies with distinct staging footprints); predictions are "
+            "uncalibrated")
+    elif anchor_calibrate:
+        light = min(load_gb, key=load_gb.get)
+        heavy = max(load_gb, key=load_gb.get)
+        for p in per_policy:
+            per_policy[p]["uncalibrated_predicted_s"] = (
+                per_policy[p]["predicted_s"]
+            )
+        # Joint two-parameter fit, alternated to a fixed point: the
+        # busy-host compute scale (matches the load-LIGHT anchor) and the
+        # dispatcher-blocking staging rate (matches the load-HEAVY one).
+        # Both are fit under the SAME final model (serial loads), since
+        # the light anchor's own staging shifts with the rate.  The graph
+        # is restored afterwards — the scale is a fitting device, not a
+        # new cost model for the caller.
+        import dataclasses
+
+        orig_times = {t.task_id: t.compute_time for t in graph}
+        try:
+            scale_total = 1.0
+            rate = link.param_load_gbps or 30.0
+            meas_light = per_policy[light]["measured_s"]
+            meas_heavy = per_policy[heavy]["measured_s"]
+
+            def predict(rate: float, policy: str) -> float:
+                l2 = dataclasses.replace(link, param_load_gbps=rate)
+                s2 = SimulatedBackend(
+                    fidelity="full", link=l2,
+                    host_slots=os.cpu_count() or 1,
+                    dispatch_s=cm.dispatch_s * scale_total,
+                    host_synchronous_transfers=host_sync,
+                    host_serial_loads=True,
+                )
+                return s2.execute(graph, cluster, scheds[policy]).makespan
+
+            clamped = False
+            for _ in range(4):
+                s = meas_light / max(predict(rate, light), 1e-12)
+                scale_total *= s
+                for t in graph:
+                    t.compute_time *= s
+                # staging rate by bisection (prediction is monotone
+                # decreasing in the rate)
+                lo_r, hi_r = 0.05, 200.0
+                if predict(hi_r, heavy) >= meas_heavy:
+                    rate, clamped = hi_r, True
+                elif predict(lo_r, heavy) <= meas_heavy:
+                    rate, clamped = lo_r, True
+                else:
+                    clamped = False
+                    for _ in range(30):
+                        mid = (lo_r * hi_r) ** 0.5
+                        if predict(mid, heavy) > meas_heavy:
+                            lo_r = mid
+                        else:
+                            hi_r = mid
+                    rate = (lo_r * hi_r) ** 0.5
+            converged = (
+                abs(predict(rate, light) / meas_light - 1.0) < 0.02
+                and abs(predict(rate, heavy) / meas_heavy - 1.0) < 0.02
+            )
+            for p in per_policy:
+                pred = predict(rate, p)
+                per_policy[p]["predicted_s"] = pred
+                per_policy[p]["ratio"] = (
+                    pred / per_policy[p]["measured_s"]
+                    if per_policy[p]["measured_s"] > 0 else float("inf")
+                )
+        finally:
+            for t in graph:
+                t.compute_time = orig_times[t.task_id]
+        calibration = {
+            "anchors": {"light": light, "heavy": heavy},
+            "compute_scale": scale_total,
+            "fitted_staging_gbps": rate,
+            "converged": converged,
+            "clamped": clamped,
+            "staging_gb": {k: round(v, 3) for k, v in load_gb.items()},
+            "note": "anchors are fitted in-sample (ratios ~1.0 when "
+                    "converged); other policies and the ordering are "
+                    "out-of-sample",
+        }
+        log(f"rankcheck: anchor calibration compute_scale="
+            f"{scale_total:.3f} staging={rate:.2f} GB/s "
+            f"(light={light}, heavy={heavy}, converged={converged}, "
+            f"clamped={clamped})")
 
     pred_order = sorted(per_policy, key=lambda p: per_policy[p]["predicted_s"])
     meas_order = sorted(per_policy, key=lambda p: per_policy[p]["measured_s"])
@@ -185,6 +310,7 @@ def run_rank_check(
         "graph": graph.name,
         "n_tasks": len(graph),
         "link_provenance": dict(cal.provenance),
+        "anchor_calibration": calibration,
         "wall_s": time.time() - t0,
     }
     log(f"rankcheck: predicted order {pred_order} vs measured {meas_order} "

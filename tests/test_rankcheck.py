@@ -82,3 +82,34 @@ def test_rank_agreement_on_mesh():
     # a tie-claim pass must be visibly disclosed as such
     if report["prediction_is_tie"]:
         assert report["prediction_spread"] <= 1.0 + report["tie_rtol"]
+
+
+def test_anchor_calibration_improves_ratios():
+    """Two-anchor in-situ calibration (eval/rankcheck.py): the record is
+    complete, uncalibrated predictions are preserved, and when the joint
+    fit converges the anchors land at ratio ~1.0."""
+    from distributed_llm_scheduler_tpu.core.fusion import fuse_linear_chains
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    dag = build_gpt2_dag(
+        GPT2Config.tiny(), batch=4, seq_len=16, microbatches=4,
+        vocab_shards=2,
+    )
+    graph = fuse_linear_chains(dag.graph)
+    r = run_rank_check(
+        graph, dag.init_params(), dag.make_inputs(),
+        policies=("roundrobin", "pipeline", "pack"),
+        hbm_cap_gb=4.0, measure_repeats=2, anchor_calibrate=True,
+    )
+    cal = r["anchor_calibration"]
+    assert cal is not None
+    assert set(cal["anchors"]) == {"light", "heavy"}
+    assert cal["compute_scale"] > 0 and cal["fitted_staging_gbps"] > 0
+    assert "converged" in cal and "clamped" in cal
+    for name in cal["anchors"].values():
+        row = r["policies"][name]
+        assert "uncalibrated_predicted_s" in row
+        if cal["converged"]:
+            assert abs(row["ratio"] - 1.0) < 0.05, (name, row, cal)
+
