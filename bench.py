@@ -338,6 +338,30 @@ def measure(
         )),
         1e-9,
     )
+    # like-for-like baseline: the scalar-reduced variant above never
+    # writes the ~400 MB logits, but every DAG/segment execution must —
+    # comparing segmented against the scalar variant overstated the
+    # segment gap by ~15% (r5 measured: fused-with-logits 9.8-10.1 ms vs
+    # fused-scalar 7.6 ms on the same session).  In-flight logits bound
+    # the rep count (the calibration helper's 1 GB budget); the scalar
+    # variant stays as the MFU anchor (purest compute measurement).  On
+    # the CPU fallback the tunnel-fence/readback asymmetry this corrects
+    # does not exist and each forward costs seconds — reuse the scalar
+    # number there.
+    if platform == "tpu":
+        from distributed_llm_scheduler_tpu.utils.costmodel import (
+            _output_capped_reps,
+        )
+
+        like_reps = min(fused_reps, _output_capped_reps(fused, fused_reps))
+        fused_like_s = max(
+            best_of(3, lambda: time_amortized(
+                lambda: fused_fn(params, ids), like_reps, rtt
+            )),
+            1e-9,
+        )
+    else:
+        fused_like_s = fused_wall_s
     fused_mfu = compute_mfu(
         graph_flops(graph), fused_wall_s, platform,
         jnp.dtype(dag.config.dtype).name,
@@ -361,14 +385,15 @@ def measure(
     dtype_name = jnp.dtype(dag.config.dtype).name
     mfu = compute_mfu(flops, pt_makespan, platform, dtype_name)
     overhead = (
-        pt_makespan / fused_wall_s - 1.0 if fused_wall_s > 0 else None
+        pt_makespan / fused_like_s - 1.0 if fused_like_s > 0 else None
     )
     log(f"bench: single-chip DAG makespan {pt_makespan*1e3:.2f} ms "
         f"(reps={pt_reps} amortized; fence rtt {rtt*1e3:.2f} ms) vs fused "
-        f"forward "
-        f"{fused_wall_s*1e3:.2f} ms"
-        + (f" (fused MFU {fused_mfu:.1%})" if fused_mfu is not None else "")
-        + f" (dispatch overhead {overhead:+.1%}); matches fused: {oracle_ok}")
+        f"forward {fused_like_s*1e3:.2f} ms with logits "
+        f"({fused_wall_s*1e3:.2f} ms scalar-reduced"
+        + (f", MFU {fused_mfu:.1%}" if fused_mfu is not None else "")
+        + f") (dispatch overhead {overhead:+.1%}); "
+        f"matches fused: {oracle_ok}")
     # segment-fused execution: the production dispatch mode — per-task
     # launches collapse into one XLA program per device-contiguous run
     seg_makespan = seg_mfu = None
@@ -509,7 +534,8 @@ def measure(
         link_provenance=link_prov,
         segmented_makespan_s=seg_makespan,
         mfu_segmented=seg_mfu,
-        fused_forward_s=fused_wall_s,
+        fused_forward_s=fused_like_s,
+        fused_scalar_s=fused_wall_s,
         fence_rtt_s=rtt,
         singlechip_replay_s=singlechip_replay_s,
         ici_sensitivity=sens,

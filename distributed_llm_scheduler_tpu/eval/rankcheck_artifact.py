@@ -71,6 +71,11 @@ def separating_leg() -> dict:
 def main(argv) -> int:
     import jax
 
+    # the axon sitecustomize re-registers the TPU plugin over
+    # JAX_PLATFORMS; the in-process override wins (both legs are
+    # CPU-mesh measurements by design)
+    jax.config.update("jax_platforms", "cpu")
+
     if not argv or not argv[0].isdigit():
         print(__doc__, file=sys.stderr)
         return 2

@@ -104,7 +104,12 @@ def graph_name_tags(microbatches: int, vocab_shards: int, dtype: Any) -> str:
     """
     return (
         (f"_mb{microbatches}" if microbatches > 1 else "")
-        + (f"_vs{vocab_shards}" if vocab_shards > 1 else "")
+        # the 'a' marks lane-ALIGNED shard boundaries (vocab_sharding
+        # .shard_bounds align=128): per-shard shapes differ from the old
+        # balanced split, and the calibration cache validates by task-id
+        # set only — the tag keeps stale pre-alignment caches from
+        # replaying wrong per-task seconds
+        + (f"_vs{vocab_shards}a" if vocab_shards > 1 else "")
         + ("" if dtype == jnp.float32 else f"_{jnp.dtype(dtype).name}")
     )
 

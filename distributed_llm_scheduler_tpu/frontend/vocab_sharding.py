@@ -18,10 +18,18 @@ import jax.numpy as jnp
 from ..core.graph import mark_batch0, mark_rootslice
 
 
-def shard_bounds(vocab_size: int, shards: int) -> List[int]:
-    """Balanced split boundaries: ``shards + 1`` cumulative offsets where the
-    first ``vocab_size % shards`` shards get one extra row — every shard is
-    non-empty for any ``1 <= shards <= vocab_size``."""
+def shard_bounds(vocab_size: int, shards: int, align: int = 128) -> List[int]:
+    """Near-balanced split boundaries: ``shards + 1`` cumulative offsets,
+    every shard non-empty for any ``1 <= shards <= vocab_size``.
+
+    Interior boundaries snap to multiples of ``align`` (the TPU lane
+    width) when the vocab is large enough: a 50257/8 balanced split puts
+    every logit-shard matmul and concat slice at a 6283-column offset —
+    off the 128-lane grid, so each shard pads/relayouts.  Aligned
+    boundaries keep all but the last shard exactly on the grid.  Any
+    split is semantically exact (each id hits exactly one shard); tiny
+    vocabs where alignment would empty a shard fall back to the balanced
+    split."""
     if not 1 <= shards <= vocab_size:
         raise ValueError(
             f"vocab_shards {shards} out of range [1, {vocab_size}]"
@@ -30,6 +38,16 @@ def shard_bounds(vocab_size: int, shards: int) -> List[int]:
     lo = [0]
     for k in range(shards):
         lo.append(lo[-1] + base + (1 if k < extra else 0))
+    if align > 1 and vocab_size >= shards * align:
+        aligned = [0]
+        for k in range(1, shards):
+            b = round(lo[k] / align) * align
+            # monotone and room for the remaining shards
+            b = max(b, aligned[-1] + align)
+            b = min(b, vocab_size - (shards - k) * align)
+            aligned.append(b)
+        aligned.append(vocab_size)
+        lo = aligned
     return lo
 
 
