@@ -40,12 +40,22 @@ from ..core.graph import TaskGraph, TaskStatus
 
 
 class QParam(NamedTuple):
-    """Symmetric per-channel int8 weight: ``deq = q * scale``."""
+    """Symmetric int8 weight: ``deq = q * scale`` in one of three scale
+    layouts, distinguished by shape:
+
+    * **channel** (:func:`quantize_array`): ``(1, ..., 1, last)`` — one
+      scale per last-axis channel.  The ONLY layout the DAG/shard path
+      accepts (:func:`rederive_shard_quants`, :func:`qparam_bytes`
+      byte accounting).
+    * **rowwise** (:func:`quantize_array_rowwise`): ``(..., n, 1)`` —
+      one scale per row; embedding tables on the decode-bench path.
+    * **grouped** (:func:`quantize_array_grouped`):
+      ``(n0/group, 1, *rest)`` — ``q.ndim + 1``; :func:`dequantize`
+      keys the grouped reshape on that rank difference.
+    """
 
     q: jax.Array      # int8, original shape
-    # float32, shape (1, ..., 1, last): one scale per last-axis channel,
-    # broadcasting over every leading axis
-    scale: jax.Array
+    scale: jax.Array  # float32, see layout table above
 
 
 def should_quantize(spec: Any, min_elems: int = 4096) -> bool:
@@ -72,10 +82,73 @@ def quantize_array(x: jax.Array) -> QParam:
     return QParam(q=q, scale=scale)
 
 
+def quantize_array_rowwise(x: jax.Array) -> QParam:
+    """Symmetric absmax int8 over the LAST axis (one scale per row).
+
+    The right orientation for embedding tables: a ``(V, D)`` table read
+    by gather (each row is one token's vector) and, when tied as the LM
+    head, contracted over ``D`` — row scales are then per-LOGIT scales,
+    so every vocab candidate's logit error is proportional to its own
+    row magnitude instead of the column-absmax outlier's.  Measured on
+    the gpt2-small decode config this cuts the prefill argmax flip rate
+    from 7.6% to 6.7% on its own (DECODE_r05 fidelity sweep)."""
+    xf = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return QParam(q=q, scale=scale)
+
+
+def quantize_array_grouped(x: jax.Array, group: int = 64) -> QParam:
+    """Per-channel scales refined along the leading (contraction) axis.
+
+    Splits axis 0 into ``group``-sized blocks, one scale per (block,
+    channel): scale shape ``(n0/group, 1, *rest)`` — ndim + 1, which is
+    how :func:`dequantize` recognizes the grouped layout.  Falls back to
+    :func:`quantize_array` when axis 0 doesn't divide evenly (e.g. the
+    8-expert leading axis of MoE weight stacks).  Byte cost: 4·n/group
+    extra scale bytes per int8 value block — 6.25% at group=64.
+    """
+    xf = jnp.asarray(x, jnp.float32)
+    n0 = xf.shape[0]
+    if xf.ndim < 2 or n0 % group or n0 == group:
+        return quantize_array(x)
+    xg = xf.reshape((n0 // group, group) + xf.shape[1:])
+    absmax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xg / scale), -127, 127).astype(jnp.int8)
+    return QParam(q=q.reshape(xf.shape), scale=scale)
+
+
+#: Embedding-table param names per model family — the tables whose
+#: consumers read ROWS (gather; tied-head contraction over the last
+#: axis), so ``scheme="grouped"`` quantizes them row-wise.  Llama and
+#: Mixtral's untied ``lm_head`` is (d, vocab): its per-channel scales
+#: are already per-logit, so it takes the grouped path instead.
+ROWWISE_EMBED_KEYS: Dict[str, tuple] = {
+    "gpt2": ("wte", "wpe"),
+    "llama": ("tok_emb",),
+    "mixtral": ("tok_emb",),
+}
+
+
 def dequantize(v: Any, dtype: Any) -> Any:
-    """QParam -> dense array in ``dtype``; anything else passes through."""
+    """QParam -> dense array in ``dtype``; anything else passes through.
+
+    Handles both scale layouts: broadcastable same-ndim scales
+    (per-channel / row-wise) and the grouped ``ndim + 1`` layout of
+    :func:`quantize_array_grouped`."""
     if isinstance(v, QParam):
-        return (v.q.astype(jnp.float32) * v.scale).astype(dtype)
+        q, scale = v.q, v.scale
+        if scale.ndim == q.ndim + 1:
+            g0 = scale.shape[0]
+            qg = q.reshape((g0, q.shape[0] // g0) + q.shape[1:])
+            return (
+                (qg.astype(jnp.float32) * scale)
+                .reshape(q.shape)
+                .astype(dtype)
+            )
+        return (q.astype(jnp.float32) * scale).astype(dtype)
     return v
 
 
@@ -91,13 +164,38 @@ def qparam_bytes(spec: Any) -> int:
 
 
 def quantize_params(
-    params: Dict[str, Any], min_elems: int = 4096
+    params: Dict[str, Any],
+    min_elems: int = 4096,
+    scheme: str = "channel",
+    group: int = 64,
+    rowwise_keys: tuple = (),
 ) -> Dict[str, Any]:
-    """Quantize every qualifying entry of a flat param dict."""
-    return {
-        k: quantize_array(v) if should_quantize(v, min_elems) else v
-        for k, v in params.items()
-    }
+    """Quantize every qualifying entry of a flat param dict.
+
+    ``scheme="channel"`` (default) is the per-channel layout every
+    byte-accounting consumer (:func:`qparam_bytes`, the DAG/streaming
+    paths) assumes.  ``scheme="grouped"`` is the higher-fidelity decode
+    variant: ``rowwise_keys`` entries (embedding tables — see
+    :data:`ROWWISE_EMBED_KEYS`) get per-row scales, everything else gets
+    ``group``-blocked contraction-axis scales.  Fidelity/byte trade-off
+    measured on gpt2-small (DECODE_r05): argmax flip rate 7.6% → 5.9%,
+    logit RMSE −18%, for +6.25% scale bytes on matrices at group=64."""
+    if scheme == "channel":
+        return {
+            k: quantize_array(v) if should_quantize(v, min_elems) else v
+            for k, v in params.items()
+        }
+    if scheme != "grouped":
+        raise ValueError(f"unknown quantization scheme {scheme!r}")
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if not should_quantize(v, min_elems):
+            out[k] = v
+        elif k in rowwise_keys:
+            out[k] = quantize_array_rowwise(v)
+        else:
+            out[k] = quantize_array_grouped(v, group)
+    return out
 
 
 def _shard_groups(names) -> Dict[str, list]:
@@ -129,6 +227,18 @@ def rederive_shard_quants(params: Dict[str, Any]) -> Dict[str, Any]:
         bq = out.get(base)
         if not isinstance(bq, QParam):
             continue
+        if bq.scale.ndim != bq.q.ndim or any(
+            s != 1 for s in bq.scale.shape[:-1]
+        ):
+            # rowwise/grouped layouts: the slice arithmetic below (scale
+            # reused verbatim for row slices, column-sliced for column
+            # slices) is only correct for channel scales — failing loud
+            # beats silently dequantizing shards against the wrong scales
+            raise ValueError(
+                f"shard group {base!r}: rederive_shard_quants supports "
+                f"only channel-layout scales, got scale shape "
+                f"{tuple(bq.scale.shape)} for q {tuple(bq.q.shape)}"
+            )
         base_shape = bq.q.shape
 
         def _shape_of(v):

@@ -227,3 +227,88 @@ def test_quantize_dag_idempotent(qsetup):
     assert (
         again.graph.total_param_gb() == qdag.graph.total_param_gb()
     )
+
+
+def test_grouped_scales_roundtrip_and_layout():
+    from distributed_llm_scheduler_tpu.utils.quantize import (
+        quantize_array_grouped,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (256, 96)) * 0.05
+    qp = quantize_array_grouped(x, group=64)
+    assert qp.q.dtype == jnp.int8 and qp.q.shape == x.shape
+    # grouped layout: one scale per (64-row block, channel), ndim + 1
+    assert qp.scale.shape == (4, 1, 96)
+    back = dequantize(qp, jnp.float32)
+    scale_full = np.repeat(np.asarray(qp.scale), 64, axis=1).reshape(256, 96)
+    assert np.all(
+        np.abs(np.asarray(back) - np.asarray(x)) <= scale_full / 2 + 1e-9
+    )
+
+
+def test_grouped_falls_back_when_axis_indivisible():
+    from distributed_llm_scheduler_tpu.utils.quantize import (
+        quantize_array_grouped,
+    )
+
+    # 8-expert leading axis: 8 % 64 != 0 -> per-channel layout
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 32, 48))
+    qp = quantize_array_grouped(x, group=64)
+    assert qp.scale.shape == (1, 1, 48)
+    np.testing.assert_allclose(
+        np.asarray(dequantize(qp, jnp.float32)),
+        np.asarray(dequantize(quantize_array(x), jnp.float32)),
+    )
+
+
+def test_rowwise_scales_for_embeddings():
+    from distributed_llm_scheduler_tpu.utils.quantize import (
+        quantize_array_rowwise,
+    )
+
+    # rows with very different magnitudes: row-wise scales keep each
+    # row's relative error bounded where column scales can't
+    rows = jnp.stack([jnp.ones(128) * 10.0 ** -i for i in range(12)])
+    qp = quantize_array_rowwise(rows)
+    assert qp.scale.shape == (12, 1)
+    back = dequantize(qp, jnp.float32)
+    rel = np.abs(np.asarray(back) - np.asarray(rows)) / np.asarray(rows)
+    assert rel.max() < 1 / 127  # every row, even the 1e-11 one
+
+    col = quantize_array(rows)
+    back_col = np.asarray(dequantize(col, jnp.float32))
+    # column scales are dominated by the 10.0 row: small rows vanish
+    assert np.all(back_col[8:] == 0)
+
+
+def test_grouped_scheme_beats_channel_on_logit_error():
+    from distributed_llm_scheduler_tpu.models import gpt2 as mod
+    from distributed_llm_scheduler_tpu.utils.quantize import (
+        ROWWISE_EMBED_KEYS,
+    )
+
+    cfg = GPT2Config.tiny()
+    params = mod.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    ref = mod.forward(params, ids, cfg).astype(jnp.float32)
+
+    def rmse(scheme_kw):
+        q = quantize_params(params, min_elems=64, **scheme_kw)
+        dense = {k: dequantize(v, cfg.dtype) for k, v in q.items()}
+        got = mod.forward(dense, ids, cfg).astype(jnp.float32)
+        return float(jnp.sqrt(jnp.mean((got - ref) ** 2)))
+
+    e_channel = rmse({})
+    e_grouped = rmse({
+        "scheme": "grouped",
+        "group": 16,
+        "rowwise_keys": ROWWISE_EMBED_KEYS["gpt2"],
+    })
+    assert e_grouped < e_channel
+
+
+def test_quantize_params_rejects_unknown_scheme():
+    with pytest.raises(ValueError, match="scheme"):
+        quantize_params({"w": jnp.ones((128, 128))}, scheme="nope")
