@@ -541,8 +541,8 @@ def cmd_generate(args) -> int:
     # and --task-graph sampling is greedy-only
     if not getattr(args, "task_graph", False):
         passed = [
-            k for k in ("scheduler", "num_nodes", "hbm_gb")
-            if getattr(args, k) is not None
+            k for k in ("scheduler", "num_nodes", "hbm_gb", "loop_steps")
+            if getattr(args, k, None) is not None
         ]
         if passed:
             print(f"--{'/--'.join(p.replace('_', '-') for p in passed)} "
@@ -556,6 +556,9 @@ def cmd_generate(args) -> int:
     elif getattr(args, "kv_int8", False):
         print("--kv-int8 applies to the whole-program decode loop; the "
               "task-graph path places dense cache slabs", file=sys.stderr)
+        return 2
+    elif getattr(args, "loop_steps", None) is not None and args.loop_steps < 1:
+        print("--loop-steps must be >= 1", file=sys.stderr)
         return 2
 
     import jax
@@ -634,8 +637,6 @@ def cmd_generate(args) -> int:
         cluster = cfg.build_cluster_with_devices()
         backend = DeviceBackend(cluster)
         new = []
-        tok_ids = ids
-        pos = 0
         # weights + zero cache slabs, allocated ONCE (shapes are fixed by
         # max_len); each step's updates fold back in functionally
         params_c = dict(params)
@@ -648,44 +649,109 @@ def cmd_generate(args) -> int:
         # position is runtime data: ONE graph + schedule per step_len
         # class (prefill, then single-token) serves every position — an
         # N-token generation compiles 2 programs, not N
-        graphs: dict = {}
-        for step in range(args.max_new_tokens):
-            step_len = tok_ids.shape[1]
-            first_of_class = step_len not in graphs
-            if first_of_class:
-                ddag = build_decode_dag_any(
-                    config, batch=1, step_len=step_len, max_len=max_len
-                )
-                sched = cfg.build_scheduler().schedule(ddag.graph, cluster)
-                if sched.failed:
-                    print(f"decode step {step}: {len(sched.failed)} tasks "
-                          "failed to place", file=sys.stderr)
-                    return 1
-                graphs[step_len] = (ddag, sched)
-            ddag, sched = graphs[step_len]
-            rep = backend.execute(
-                ddag.graph, sched, params_c,
-                decode_inputs(tok_ids, pos, max_len=max_len),
-                keep_outputs=True,
-                # jit caches are hot after a class's first step: skip the
-                # throwaway warmup run or every later token executes twice
-                warmup=first_of_class,
+        loop_k = getattr(args, "loop_steps", None)
+        if args.max_new_tokens > 0:
+            # shared prefill: one scheduled dispatch of the prompt-length
+            # class, cache updates folded functionally, first token by
+            # on-device argmax (one int32 crosses the link, not logits)
+            pdag = build_decode_dag_any(
+                config, batch=1, step_len=len(prompt), max_len=max_len
             )
-            nxt = int(np.asarray(rep.output)[0, -1, :].argmax())
-            new.append(nxt)
-            tok_ids = jnp.asarray([[nxt]], dtype=jnp.int32)
-            if step < args.max_new_tokens - 1:  # last step's update unused
+            sched_p = cfg.build_scheduler().schedule(pdag.graph, cluster)
+            if sched_p.failed:
+                print(f"prefill: {len(sched_p.failed)} tasks failed to "
+                      "place", file=sys.stderr)
+                return 1
+            rep = backend.execute(
+                pdag.graph, sched_p, params_c,
+                decode_inputs(ids, 0, max_len=max_len), keep_outputs=True,
+            )
+            if args.max_new_tokens > 1:  # sole step's update unused
                 params_c = apply_cache_updates(
-                    params_c, rep.task_outputs, config, pos=pos
+                    params_c, rep.task_outputs, config, pos=0
                 )
-            pos += step_len
-        print(json.dumps({
+            cur = jnp.argmax(
+                rep.output[:, -1, :], axis=-1
+            ).astype(jnp.int32)[:, None]
+            new.append(int(np.asarray(cur)[0, 0]))
+            pos = len(prompt)
+        remaining = max(args.max_new_tokens - 1, 0)
+        if remaining:
+            ddag = build_decode_dag_any(
+                config, batch=1, step_len=1, max_len=max_len
+            )
+            sched_d = cfg.build_scheduler().schedule(ddag.graph, cluster)
+            if sched_d.failed:
+                print(f"decode step: {len(sched_d.failed)} tasks failed "
+                      "to place", file=sys.stderr)
+                return 1
+        if remaining and loop_k is not None:
+            # amortized path: decode runs in loop_k-token windows — one
+            # composed lax.scan program over the scheduled step DAG per
+            # window (backends/decode_loop), one host round-trip per
+            # window instead of per token
+            from .backends.decode_loop import (
+                build_decode_loop,
+                split_cache_params,
+            )
+
+            weights, caches = split_cache_params(params_c)
+            loops: dict = {}  # two jits at most: full + tail window
+            while remaining:
+                k = min(loop_k, remaining)
+                if k not in loops:
+                    try:
+                        loops[k] = build_decode_loop(
+                            ddag.graph, sched_d, config, steps=k
+                        )
+                    except ValueError as e:
+                        if "single-node placement" not in str(e):
+                            raise
+                        # the loop only amortizes the single-device
+                        # steady state
+                        print(f"{e}; drop --loop-steps for the "
+                              "per-token dispatch path", file=sys.stderr)
+                        return 2
+                toks, caches = loops[k](
+                    weights, caches, cur, jnp.int32(pos)
+                )
+                new.extend(int(t) for t in np.asarray(toks)[0])
+                cur = toks[:, -1:]
+                pos += k
+                remaining -= k
+        elif remaining:
+            first_of_class = True
+            while remaining:
+                rep = backend.execute(
+                    ddag.graph, sched_d, params_c,
+                    decode_inputs(cur, pos, max_len=max_len),
+                    keep_outputs=True,
+                    # jit caches are hot after a class's first step: skip
+                    # the throwaway warmup run or every later token
+                    # executes twice
+                    warmup=first_of_class,
+                )
+                first_of_class = False
+                cur = jnp.argmax(
+                    rep.output[:, -1, :], axis=-1
+                ).astype(jnp.int32)[:, None]
+                new.append(int(np.asarray(cur)[0, 0]))
+                remaining -= 1
+                if remaining:  # last step's update unused
+                    params_c = apply_cache_updates(
+                        params_c, rep.task_outputs, config, pos=pos
+                    )
+                pos += 1
+        result = {
             "model": args.model,
             "prompt_ids": prompt,
             "generated_ids": new,
             "task_graph": True,
             "scheduler": cfg.scheduler,
-        }))
+        }
+        if loop_k is not None:
+            result["loop_steps"] = loop_k
+        print(json.dumps(result))
         return 0
 
     try:
@@ -907,6 +973,13 @@ def main(argv=None) -> int:
     p.add_argument("--scheduler", default=None)
     p.add_argument("--num-nodes", type=int, default=None)
     p.add_argument("--hbm-gb", type=float, default=None)
+    p.add_argument("--loop-steps", type=int, default=None, dest="loop_steps",
+                   help="with --task-graph: fold N decode steps into one "
+                        "dispatched program (backends/decode_loop — "
+                        "lax.scan over the scheduled step DAG, caches "
+                        "donated), paying one host round-trip per N "
+                        "tokens instead of per token; requires the "
+                        "schedule to place on a single node")
     p.set_defaults(fn=cmd_generate)
 
     p = sub.add_parser("bench", help="north-star benchmark (one JSON line)")

@@ -181,3 +181,50 @@ def test_generate_task_graph_matches_whole_program():
     b = json.loads(tg.stdout)
     assert b["task_graph"] is True
     assert a["generated_ids"] == b["generated_ids"]
+
+
+def test_generate_task_graph_loop_steps_matches():
+    """--loop-steps folds decode windows into one dispatched program per
+    window (backends/decode_loop); tokens must equal the whole-program
+    path, including a ragged tail window (6 tokens = 1 prefill + windows
+    2 + 2 + 1)."""
+    plain = _run(
+        "--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+        "--max-new-tokens", "6", timeout=400,
+    )
+    assert plain.returncode == 0, plain.stderr
+    looped = _run(
+        "--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+        "--max-new-tokens", "6", "--task-graph", "--scheduler", "heft",
+        "--num-nodes", "1", "--loop-steps", "2", timeout=400,
+    )
+    assert looped.returncode == 0, looped.stderr
+    a = json.loads(plain.stdout)
+    b = json.loads(looped.stdout)
+    assert b["loop_steps"] == 2 and b["task_graph"] is True
+    assert a["generated_ids"] == b["generated_ids"]
+
+
+def test_loop_steps_requires_task_graph():
+    r = _run("--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+             "--loop-steps", "4")
+    assert r.returncode == 2
+    assert "--task-graph" in r.stderr
+
+
+def test_loop_steps_rejects_nonpositive():
+    r = _run("--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+             "--task-graph", "--loop-steps", "0")
+    assert r.returncode == 2
+    assert ">= 1" in r.stderr
+
+
+def test_task_graph_zero_new_tokens():
+    """--max-new-tokens 0 returns empty ids on both task-graph paths
+    (the loop path must not enter a negative-length window)."""
+    for extra in ([], ["--loop-steps", "2"]):
+        r = _run("--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+                 "--max-new-tokens", "0", "--task-graph", *extra,
+                 timeout=400)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)["generated_ids"] == []
