@@ -365,10 +365,61 @@ def _injected_recovery(
     return summary
 
 
+def _visualize_menu(args, cfg) -> int:
+    """Stdin-driven visualization menu (reference ``visu.py:294-339``):
+    re-render, switch policy, and inspect without re-running the CLI.
+    Figures still save to files; ``--show`` additionally opens them."""
+    from .visu.plots import visualize_dag, visualize_schedule
+
+    dag = cfg.build_graph()
+    graph = getattr(dag, "graph", dag)
+    banner = ("[1] simple DAG  [2] detailed DAG  [3 <policy>] gantt "
+              f"(default {cfg.scheduler})  [4] summary  [q] quit")
+    print(banner)
+    while True:
+        try:
+            choice = input("> ").strip()
+        except EOFError:
+            return 0
+        if choice in ("q", "quit", "exit"):
+            return 0
+        if choice in ("1", "2"):
+            print("dag ->", visualize_dag(
+                graph, f"{cfg.out_dir}/{graph.name}.dag.png",
+                detailed=choice == "2", show=args.show,
+            ))
+        elif choice == "3" or choice.startswith("3 "):
+            policy = choice[1:].strip() or cfg.scheduler
+            from . import get_scheduler
+
+            try:
+                sched_cls = get_scheduler(policy)
+            except KeyError as e:
+                print(e)
+                continue
+            # fresh graph + cluster per render: scheduling mutates state
+            d2 = cfg.build_graph()
+            g2 = getattr(d2, "graph", d2)
+            cluster = cfg.build_cluster()
+            schedule = sched_cls.schedule(g2, cluster)
+            _replay_backend(cfg).execute(g2, cluster, schedule)
+            print("gantt ->", visualize_schedule(
+                schedule, f"{cfg.out_dir}/{g2.name}.{policy}.gantt.png",
+                show=args.show,
+            ))
+        elif choice == "4":
+            for k, v in graph.summary().items():
+                print(f"  {k}: {v}")
+        else:
+            print(f"unknown choice {choice!r}; {banner}")
+
+
 def cmd_visualize(args) -> int:
     from .visu.plots import visualize_dag, visualize_schedule
 
     cfg = _config_from(args)
+    if getattr(args, "menu", False):
+        return _visualize_menu(args, cfg)
     dag = cfg.build_graph()
     graph = getattr(dag, "graph", dag)
     print("dag ->", visualize_dag(
@@ -917,6 +968,10 @@ def main(argv=None) -> int:
     p.add_argument("--show", action="store_true",
                    help="also open figures in a window (interactive analog "
                         "of the reference's visu menu)")
+    p.add_argument("--menu", action="store_true",
+                   help="stdin-driven menu loop: re-render DAG/Gantt, "
+                        "switch policies, and print summaries without "
+                        "re-running the CLI")
     p.set_defaults(fn=cmd_visualize)
 
     p = sub.add_parser("train", help="run sharded training steps")

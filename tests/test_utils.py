@@ -214,3 +214,29 @@ def test_public_surface_resolves():
 
     for name in dls.__all__:
         assert getattr(dls, name, None) is not None, name
+
+
+def test_cli_visualize_menu(tmp_path):
+    """--menu drives the stdin loop (reference visu.py:294-339 analog):
+    render both DAG styles, a gantt for an explicit policy, print the
+    summary, reject an unknown choice, and exit cleanly on q."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["DLS_FORCE_CPU"] = "1"
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_scheduler_tpu",
+         "visualize", "--model", "llm", "--num-layers", "2",
+         "--num-nodes", "2", "--hbm-gb", "8", "--out-dir", str(tmp_path),
+         "--menu"],
+        input="1\n2\n3 mru\n4\nbogus\nq\n",
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(__file__)), env=env,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.count("dag ->") == 2
+    assert "gantt ->" in r.stdout
+    assert "num_tasks" in r.stdout or "tasks" in r.stdout  # summary keys
+    assert "unknown choice" in r.stdout
+    assert any(".mru.gantt.png" in f or f.endswith(".gantt.png")
+               for f in os.listdir(tmp_path))
