@@ -346,3 +346,61 @@ def test_decode_loop_rejects_multi_node_placement():
     sched = get_scheduler("roundrobin").schedule(ddag.graph, cluster)
     with pytest.raises(ValueError, match="single-node"):
         compose_step_fn(ddag.graph, sched, CFG)
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral"])
+def test_decode_loop_token_exact_backbones(family):
+    """The K-step on-device loop is family-generic: Llama (GQA + RoPE)
+    and Mixtral (per-step MoE routing) loop tokens must equal the
+    whole-program greedy stream, same pin as the gpt2 loop test."""
+    from distributed_llm_scheduler_tpu.backends.decode_loop import (
+        build_decode_loop,
+        split_cache_params,
+    )
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_decode_dag_any,
+    )
+
+    if family == "llama":
+        from distributed_llm_scheduler_tpu.models import llama as mod
+        from distributed_llm_scheduler_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.tiny()
+    else:
+        from distributed_llm_scheduler_tpu.models import mixtral as mod
+        from distributed_llm_scheduler_tpu.models.mixtral import (
+            MixtralConfig,
+        )
+
+        cfg = MixtralConfig.tiny()
+    b, p_len, m, n_new = 2, 6, 16, 4
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (b, p_len), 0, cfg.vocab_size,
+        dtype=jnp.int32,
+    )
+    model_params = mod.init_params(cfg, jax.random.PRNGKey(0))
+    want = mod.generate(model_params, ids, cfg, max_new_tokens=n_new)
+
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    dag = build_decode_dag_any(cfg, batch=b, step_len=p_len, max_len=m)
+    params = dag.init_params()
+    params.update(model_params)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    rep = backend.execute(
+        dag.graph, sched, params, decode_inputs(ids, 0), keep_outputs=True
+    )
+    params = apply_cache_updates(params, rep.task_outputs, cfg, pos=0)
+    tok0 = jnp.argmax(np.asarray(rep.output)[:, -1, :], axis=-1).astype(
+        jnp.int32
+    )[:, None]
+
+    ddag = build_decode_dag_any(cfg, batch=b, step_len=1, max_len=m)
+    dsched = get_scheduler("greedy").schedule(ddag.graph, cluster)
+    weights, caches = split_cache_params(params)
+    loop = build_decode_loop(ddag.graph, dsched, cfg, steps=n_new - 1)
+    toks, _ = loop(weights, caches, tok0, jnp.int32(p_len))
+    got = jnp.concatenate([tok0, toks], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(want[:, p_len:p_len + n_new]), np.asarray(got)
+    )
