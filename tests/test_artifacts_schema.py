@@ -54,6 +54,11 @@ def test_decode_artifact_schema():
               "graph_classes_compiled"):
         assert k in tg, (path, k)
     assert tg["oracle_ok"] is True
+    tg_loop = tg.get("looped")
+    if tg_loop is not None:  # K-step on-device loop leg added r5
+        assert "error" not in tg_loop, path
+        for k in ("tok_s", "token_agreement_vs_whole_program"):
+            assert k in tg_loop, (path, k)
     q = d.get("quantized")
     if q is not None:  # int8 leg added mid-r4; absent from older captures
         assert "error" not in q, path
@@ -61,6 +66,9 @@ def test_decode_artifact_schema():
         for k in ("decode_tok_s", "token_agreement",
                   "first_token_agreement"):
             assert k in q, (path, k)
+        if "quant_scheme" in q:  # grouped+rowwise fidelity scheme, r5
+            for k in ("argmax_flip_rate", "logit_rmse"):
+                assert k in q, (path, k)
     qkv = d.get("quantized_kv")
     if qkv is not None:
         assert "error" not in qkv, path
