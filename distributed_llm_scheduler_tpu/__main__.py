@@ -611,6 +611,11 @@ def cmd_generate(args) -> int:
     elif getattr(args, "loop_steps", None) is not None and args.loop_steps < 1:
         print("--loop-steps must be >= 1", file=sys.stderr)
         return 2
+    elif getattr(args, "quantize", "none") != "none":
+        print("--quantize applies to the whole-program decode loop; the "
+              "task-graph path places fp cache slabs and weights",
+              file=sys.stderr)
+        return 2
 
     import jax
     import jax.numpy as jnp
@@ -805,23 +810,58 @@ def cmd_generate(args) -> int:
         print(json.dumps(result))
         return 0
 
+    quantized = getattr(args, "quantize", "none") == "int8"
     try:
-        out = mod.generate(
-            params, ids, config, max_new_tokens=args.max_new_tokens,
-            temperature=args.temperature, top_k=args.top_k,
-            key=jax.random.PRNGKey(args.seed),
-            kv_int8=bool(getattr(args, "kv_int8", False)),
-        )
+        if quantized:
+            # int8 weights in HBM (decode is bandwidth-bound), dequantized
+            # inside the jitted step — the grouped+rowwise fidelity scheme
+            # the decode bench measures (utils/quantize.quantize_params)
+            from .models import decode as decode_mod
+            from .utils.quantize import (
+                ROWWISE_EMBED_KEYS,
+                dequantize,
+                quantize_params,
+            )
+
+            fam = _weights_family(args.model)
+            qparams = quantize_params(
+                params, scheme="grouped",
+                rowwise_keys=ROWWISE_EMBED_KEYS.get(fam, ()),
+            )
+            dt = jnp.dtype(config.dtype)
+
+            def fwd_q(p, *a, **kw):
+                return mod.forward_cached(
+                    {k: dequantize(v, dt) for k, v in p.items()}, *a, **kw
+                )
+
+            out = decode_mod.generate(
+                fwd_q, mod.init_cache, qparams, ids, config,
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                key=jax.random.PRNGKey(args.seed),
+                kv_int8=bool(getattr(args, "kv_int8", False)),
+            )
+        else:
+            out = mod.generate(
+                params, ids, config, max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                key=jax.random.PRNGKey(args.seed),
+                kv_int8=bool(getattr(args, "kv_int8", False)),
+            )
     except ValueError as e:  # e.g. past the model's position limit
         print(str(e), file=sys.stderr)
         return 2
     new = [int(t) for t in out[0, len(prompt):]]
-    print(json.dumps({
+    result = {
         "model": args.model,
         "prompt_ids": prompt,
         "generated_ids": new,
         "temperature": args.temperature,
-    }))
+    }
+    if quantized:
+        result["weights"] = "int8"
+    print(json.dumps(result))
     return 0
 
 
@@ -1014,6 +1054,11 @@ def main(argv=None) -> int:
                         "(models/decode.quantize_cache): ~2x fewer cache "
                         "bytes re-read per step; lossy (greedy tokens can "
                         "differ from the bf16-cache run)")
+    p.add_argument("--quantize", default="none", choices=["none", "int8"],
+                   help="int8 weights for the whole-program decode loop "
+                        "(grouped+rowwise scales, dequantized on device "
+                        "inside the jitted step): ~half the weight bytes "
+                        "re-read per token; lossy like --kv-int8")
     p.add_argument("--task-graph", action="store_true", dest="task_graph",
                    help="generate through the scheduling layer: decode "
                         "steps as task DAGs (KV-cache slabs as placeable "

@@ -228,3 +228,25 @@ def test_task_graph_zero_new_tokens():
                  timeout=400)
         assert r.returncode == 0, r.stderr
         assert json.loads(r.stdout)["generated_ids"] == []
+
+
+def test_generate_quantized_weights():
+    """--quantize int8 decodes on dequant-shimmed int8 weights; at f32
+    tiny scale greedy tokens equal the fp path (no near-ties to flip)."""
+    fp = _run("--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+              "--max-new-tokens", "4")
+    assert fp.returncode == 0, fp.stderr
+    q = _run("--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+             "--max-new-tokens", "4", "--quantize", "int8")
+    assert q.returncode == 0, q.stderr
+    a, b = json.loads(fp.stdout), json.loads(q.stdout)
+    assert b["weights"] == "int8"
+    assert len(b["generated_ids"]) == 4
+    assert a["generated_ids"] == b["generated_ids"]
+
+
+def test_generate_quantize_rejects_task_graph():
+    r = _run("--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+             "--task-graph", "--quantize", "int8")
+    assert r.returncode == 2
+    assert "whole-program" in r.stderr
