@@ -50,6 +50,41 @@ def kendall_tau(order_a: list, order_b: list) -> float:
     return (concordant - discordant) / (n * (n - 1) / 2)
 
 
+def tie_groups(
+    ordered: list, values: Dict[str, float], rtol: float
+) -> list:
+    """Partition an already-sorted item list into predicted-tie groups:
+    an item joins the current group when its value is within ``rtol`` of
+    the group's FIRST (smallest) member.  The sim's resolution defines
+    the claim — items inside one group are "predicted tied", and only
+    CROSS-group order is a falsifiable prediction."""
+    groups: list = []
+    for p in ordered:
+        if groups and values[p] <= values[groups[-1][0]] * (1.0 + rtol):
+            groups[-1].append(p)
+        else:
+            groups.append([p])
+    return groups
+
+
+def cross_group_agreement(
+    groups: list, measured: Dict[str, float]
+) -> Optional[float]:
+    """Fraction of cross-group pairs whose measured order matches the
+    predicted group order (1.0 = every pair the sim actually claimed an
+    order for came out that way).  None when every item shares one group
+    (no falsifiable cross-group claim)."""
+    ok = tot = 0
+    for gi in range(len(groups)):
+        for gj in range(gi + 1, len(groups)):
+            for a in groups[gi]:
+                for b in groups[gj]:
+                    tot += 1
+                    if measured[a] <= measured[b]:
+                        ok += 1
+    return ok / tot if tot else None
+
+
 def run_rank_check(
     graph: TaskGraph,
     params: Dict[str, Any],
@@ -296,6 +331,18 @@ def run_rank_check(
         "predicted_order": pred_order,
         "measured_order": meas_order,
         "kendall_tau": tau,
+        # tie-aware agreement: raw tau penalizes measured jumbling INSIDE
+        # a predicted near-tie (e.g. three policies predicted within 4%
+        # measure in noise-order on a busy host).  Grouping by tie_rtol
+        # scores only the orderings the sim actually claimed.
+        "prediction_groups": (groups := tie_groups(
+            pred_order,
+            {p: per_policy[p]["predicted_s"] for p in per_policy},
+            tie_rtol,
+        )),
+        "cross_group_agreement": cross_group_agreement(
+            groups, {p: per_policy[p]["measured_s"] for p in per_policy}
+        ),
         # max/min predicted makespan: how strongly the sim claims a
         # winner at all (1.0 = it calls the policies a dead tie)
         "prediction_spread": prediction_spread,

@@ -113,3 +113,32 @@ def test_anchor_calibration_improves_ratios():
         if cal["converged"]:
             assert abs(row["ratio"] - 1.0) < 0.05, (name, row, cal)
 
+
+
+def test_tie_groups_partitions_by_rtol():
+    from distributed_llm_scheduler_tpu.eval.rankcheck import tie_groups
+
+    vals = {"a": 1.00, "b": 1.05, "c": 1.08, "d": 1.50, "e": 1.52}
+    order = ["a", "b", "c", "d", "e"]
+    # 10% rtol vs the group LEADER: a/b/c group (1.08 <= 1.1), d/e group
+    assert tie_groups(order, vals, 0.10) == [["a", "b", "c"], ["d", "e"]]
+    # 1% rtol: everything separates except d/e (1.52 <= 1.515? no)
+    assert tie_groups(order, vals, 0.01) == [
+        ["a"], ["b"], ["c"], ["d"], ["e"]
+    ]
+
+
+def test_cross_group_agreement_scores_only_claimed_pairs():
+    from distributed_llm_scheduler_tpu.eval.rankcheck import (
+        cross_group_agreement,
+    )
+
+    groups = [["a", "b"], ["c"]]
+    # within-group jumbling is free; both cross pairs ordered correctly
+    meas = {"a": 2.0, "b": 1.0, "c": 3.0}
+    assert cross_group_agreement(groups, meas) == 1.0
+    # one cross pair violated (b measured after c)
+    meas = {"a": 2.0, "b": 4.0, "c": 3.0}
+    assert cross_group_agreement(groups, meas) == 0.5
+    # single group: no falsifiable claim
+    assert cross_group_agreement([["a", "b", "c"]], meas) is None
