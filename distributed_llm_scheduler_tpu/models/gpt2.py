@@ -174,7 +174,24 @@ def residual_add(a: jax.Array, b: jax.Array) -> jax.Array:
 
 def output_projection(x: jax.Array, wte: jax.Array) -> jax.Array:
     """Logits via weight tying with the embedding table
-    (reference test_gpt2.py:160-166)."""
+    (reference test_gpt2.py:160-166).
+
+    At decode shapes (few rows against the full table) ``x @ wte.T``
+    makes XLA stream the (V, D) table against its storage order — the
+    same transposed-operand stall the decode attention fix measured at
+    ~1/5 of HBM rate (models/decode._decode_attention_natural).  For
+    small row counts the scores compute as ``wte · x`` instead — both
+    operands contract their LAST axis (lanes), no transpose
+    materialized — and only the tiny (V, rows) result transposes.  Row
+    threshold 64: past that the matmul is MXU-compute-bound and the big
+    output transpose would cost more than it saves."""
+    B, T, D = x.shape
+    if B * T <= 64:
+        flat = x.reshape(B * T, D)
+        scores = jax.lax.dot_general(
+            wte, flat, (((1,), (1,)), ((), ()))
+        )  # (V, B*T): wte rows on sublanes, contraction on lanes
+        return scores.T.reshape(B, T, wte.shape[0])
     return x @ wte.T
 
 
