@@ -117,3 +117,24 @@ def test_models_use_dispatcher():
     logits = gpt2.forward(params, ids, config)
     assert logits.shape == (1, 32, config.vocab_size)
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_output_projection_orientations_agree():
+    """The decode-shape MXU-natural head (wte @ x', contraction on lanes
+    for both operands) must produce the standard x @ wte.T logits on
+    both sides of the 64-row threshold."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llm_scheduler_tpu.models.gpt2 import output_projection
+
+    wte = jax.random.normal(jax.random.PRNGKey(0), (512, 64))
+    for b, t in ((2, 1), (8, 8), (4, 32)):  # 2, 64 (boundary), 128 rows
+        x = jax.random.normal(jax.random.PRNGKey(1), (b, t, 64))
+        got = output_projection(x, wte)
+        want = x @ wte.T
+        assert got.shape == (b, t, 512)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
