@@ -611,11 +611,9 @@ def cmd_generate(args) -> int:
     elif getattr(args, "loop_steps", None) is not None and args.loop_steps < 1:
         print("--loop-steps must be >= 1", file=sys.stderr)
         return 2
-    elif getattr(args, "quantize", "none") != "none":
-        print("--quantize applies to the whole-program decode loop; the "
-              "task-graph path places fp cache slabs and weights",
-              file=sys.stderr)
-        return 2
+    # --quantize composes with --task-graph: weights quantize (channel
+    # scheme — the DAG path's byte-accounting contract), cache slabs
+    # stay fp (quantize_dag exclude_prefixes)
 
     import jax
     import jax.numpy as jnp
@@ -706,13 +704,28 @@ def cmd_generate(args) -> int:
         # class (prefill, then single-token) serves every position — an
         # N-token generation compiles 2 programs, not N
         loop_k = getattr(args, "loop_steps", None)
+        quantize_tg = getattr(args, "quantize", "none") == "int8"
+        if quantize_tg:
+            # int8 WEIGHTS through the scheduler (channel scheme — the
+            # DAG path's byte-accounting contract); cache slabs stay fp,
+            # the per-step write path updates them in place
+            from .utils.quantize import quantize_dag, quantize_like
+
+        def _tg_dag(step_len):
+            d = build_decode_dag_any(
+                config, batch=1, step_len=step_len, max_len=max_len
+            )
+            return quantize_dag(
+                d, exclude_prefixes=("cache_",)
+            ) if quantize_tg else d
+
         if args.max_new_tokens > 0:
             # shared prefill: one scheduled dispatch of the prompt-length
             # class, cache updates folded functionally, first token by
             # on-device argmax (one int32 crosses the link, not logits)
-            pdag = build_decode_dag_any(
-                config, batch=1, step_len=len(prompt), max_len=max_len
-            )
+            pdag = _tg_dag(len(prompt))
+            if quantize_tg:
+                params_c = quantize_like(pdag, params_c)
             sched_p = cfg.build_scheduler().schedule(pdag.graph, cluster)
             if sched_p.failed:
                 print(f"prefill: {len(sched_p.failed)} tasks failed to "
@@ -733,9 +746,7 @@ def cmd_generate(args) -> int:
             pos = len(prompt)
         remaining = max(args.max_new_tokens - 1, 0)
         if remaining:
-            ddag = build_decode_dag_any(
-                config, batch=1, step_len=1, max_len=max_len
-            )
+            ddag = _tg_dag(1)
             sched_d = cfg.build_scheduler().schedule(ddag.graph, cluster)
             if sched_d.failed:
                 print(f"decode step: {len(sched_d.failed)} tasks failed "
@@ -807,6 +818,8 @@ def cmd_generate(args) -> int:
         }
         if loop_k is not None:
             result["loop_steps"] = loop_k
+        if quantize_tg:
+            result["weights"] = "int8"
         print(json.dumps(result))
         return 0
 
@@ -1055,10 +1068,12 @@ def main(argv=None) -> int:
                         "bytes re-read per step; lossy (greedy tokens can "
                         "differ from the bf16-cache run)")
     p.add_argument("--quantize", default="none", choices=["none", "int8"],
-                   help="int8 weights for the whole-program decode loop "
-                        "(grouped+rowwise scales, dequantized on device "
-                        "inside the jitted step): ~half the weight bytes "
-                        "re-read per token; lossy like --kv-int8")
+                   help="int8 weights, dequantized on device inside the "
+                        "jitted step: ~half the weight bytes re-read per "
+                        "token; lossy like --kv-int8.  Whole-program path "
+                        "uses the grouped+rowwise fidelity scheme; "
+                        "--task-graph quantizes the placed weight tasks "
+                        "(channel scheme, cache slabs stay fp)")
     p.add_argument("--task-graph", action="store_true", dest="task_graph",
                    help="generate through the scheduling layer: decode "
                         "steps as task DAGs (KV-cache slabs as placeable "

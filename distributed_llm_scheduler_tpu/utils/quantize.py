@@ -304,7 +304,9 @@ def quantize_like(dag: Any, params: Dict[str, Any]) -> Dict[str, Any]:
     return rederive_shard_quants(out)
 
 
-def quantize_dag(dag: Any, min_elems: int = 4096) -> Any:
+def quantize_dag(
+    dag: Any, min_elems: int = 4096, exclude_prefixes: tuple = ()
+) -> Any:
     """A ModelDAG whose qualifying weights are int8 end-to-end.
 
     Returns a new dag (the input is untouched): fns wrapped with on-device
@@ -312,10 +314,16 @@ def quantize_dag(dag: Any, min_elems: int = 4096) -> Any:
     swapped to QParam pytrees, ``init_params``/``reference_forward``
     quantization-aware, and the graph renamed with an ``_int8`` tag (cost
     model caches key on the name).
+
+    ``exclude_prefixes``: param names starting with any of these stay in
+    their original dtype — decode DAGs quantize weights but must keep
+    ``cache_*`` slabs fp (the per-step cache write path updates them in
+    place; re-rounding a cache every step would compound error).
     """
     quantized = {
         name for name, spec in dag.param_specs.items()
         if should_quantize(spec, min_elems)
+        and not any(name.startswith(px) for px in exclude_prefixes)
     }
     # quantization is decided per SHARD GROUP, not per tensor: vocab
     # shards must follow their base table (they carry slices of its

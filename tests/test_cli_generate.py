@@ -245,8 +245,24 @@ def test_generate_quantized_weights():
     assert a["generated_ids"] == b["generated_ids"]
 
 
-def test_generate_quantize_rejects_task_graph():
-    r = _run("--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
-             "--task-graph", "--quantize", "int8")
-    assert r.returncode == 2
-    assert "whole-program" in r.stderr
+def test_generate_quantized_task_graph_paths_agree():
+    """--quantize int8 composes with --task-graph: the per-token and
+    looped dispatch modes run the SAME channel-quantized weights, so
+    their tokens must match exactly on the CPU mesh."""
+    per_tok = _run(
+        "--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+        "--max-new-tokens", "4", "--task-graph", "--scheduler", "heft",
+        "--num-nodes", "1", "--quantize", "int8", timeout=400,
+    )
+    assert per_tok.returncode == 0, per_tok.stderr
+    looped = _run(
+        "--model", "gpt2-tiny", "--prompt-ids", "5,6,7",
+        "--max-new-tokens", "4", "--task-graph", "--scheduler", "heft",
+        "--num-nodes", "1", "--quantize", "int8", "--loop-steps", "2",
+        timeout=400,
+    )
+    assert looped.returncode == 0, looped.stderr
+    a, b = json.loads(per_tok.stdout), json.loads(looped.stdout)
+    assert a["weights"] == b["weights"] == "int8"
+    assert len(a["generated_ids"]) == 4
+    assert a["generated_ids"] == b["generated_ids"]
