@@ -647,6 +647,47 @@ def measure_decode_dag(
                 float((ours == full2).mean()), 4
             ),
         }
+        # int8-weight variant of the same window: the placed weight
+        # tasks quantized through quantize_dag (channel scheme, cache
+        # slabs fp — the CLI's --task-graph --quantize composition),
+        # timed from the same mid-state the bf16 window restarts from
+        from ..utils.quantize import QParam, quantize_dag, quantize_like
+
+        qd = quantize_dag(ddag2, exclude_prefixes=("cache_",))
+        qsched = get_scheduler(policy).schedule(qd.graph, cluster)
+        qparams = quantize_like(qd, dict(params2))
+        qweights, _ = split_cache_params(qparams)
+        qloop = build_decode_loop(qd.graph, qsched, config, steps=K)
+        qtoks_warm, _ = qloop(
+            qweights, {k: jnp.array(v) for k, v in mid.items()},
+            tok_mid, jnp.int32(prompt_len + K),
+        )  # compiles; its tokens double as the agreement sample
+        qtoks_np = np.asarray(qtoks_warm)
+
+        def timed_q():
+            c = {k: jnp.array(v) for k, v in mid.items()}
+            for v in c.values():
+                v.block_until_ready()
+            t0 = _time.perf_counter()
+            toks, _ = qloop(
+                qweights, c, tok_mid, jnp.int32(prompt_len + K)
+            )
+            np.asarray(toks)
+            return _time.perf_counter() - t0
+
+        qwall = min(timed_q() for _ in range(3))
+        looped["int8_weights"] = {
+            "tok_s": round(batch * K / qwall, 2),
+            "ms_per_token": round(qwall * 1e3 / K, 4),
+            "weight_bytes": int(sum(
+                (v.q.nbytes + v.scale.nbytes) if isinstance(v, QParam)
+                else getattr(v, "nbytes", 0)
+                for v in qweights.values()
+            )),
+            "token_agreement_vs_bf16_loop": round(
+                float((qtoks_np == toks2_np).mean()), 4
+            ),
+        }
     except Exception:
         import traceback
 

@@ -59,6 +59,10 @@ def test_decode_artifact_schema():
         assert "error" not in tg_loop, path
         for k in ("tok_s", "token_agreement_vs_whole_program"):
             assert k in tg_loop, (path, k)
+        if "int8_weights" in tg_loop:  # scheduled-int8 window, late r5
+            for k in ("tok_s", "weight_bytes",
+                      "token_agreement_vs_bf16_loop"):
+                assert k in tg_loop["int8_weights"], (path, k)
     q = d.get("quantized")
     if q is not None:  # int8 leg added mid-r4; absent from older captures
         assert "error" not in q, path

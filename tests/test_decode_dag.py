@@ -277,6 +277,10 @@ def test_measure_decode_dag_bench_leg():
     assert r["looped"] is not None
     assert r["looped"]["token_agreement_vs_whole_program"] == 1.0
     assert r["looped"]["tok_s"] > 0
+    # int8-weight window: runs, byte-counted, tokens vs the bf16 window
+    q = r["looped"]["int8_weights"]
+    assert q["tok_s"] > 0 and q["weight_bytes"] > 0
+    assert 0.0 <= q["token_agreement_vs_bf16_loop"] <= 1.0
 
 
 def test_decode_loop_token_exact_and_chains():
