@@ -168,6 +168,59 @@ def cmd_schedule(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Static analysis (analysis/): build the DAG, schedule it, and lint
+    graph + schedule + memory + sharding + quantization without executing
+    anything.  Exit 1 on errors, 0 otherwise."""
+    from .analysis import _spec_shapes, analyze
+    from .parallel.mesh import factorize_mesh
+
+    cfg = _config_from(args)
+    if args.decode and _weights_family(cfg.model) is None:
+        print("--decode needs a real model family (gpt2*/llama*/mixtral*)",
+              file=sys.stderr)
+        return 2
+    if args.decode:
+        from .frontend.decode_dag import build_decode_dag_any
+
+        dag = build_decode_dag_any(cfg.model_config(), batch=cfg.batch)
+        if cfg.quantize == "int8":
+            from .utils.quantize import quantize_dag
+
+            dag = quantize_dag(dag)
+    else:
+        dag = cfg.build_graph()
+    graph = getattr(dag, "graph", dag)
+    cluster = cfg.build_cluster()
+    schedule = cfg.build_scheduler().schedule(graph, cluster)
+
+    family = _weights_family(cfg.model)
+    param_specs = getattr(dag, "param_specs", None)
+    param_shapes = mesh_axes = None
+    if family is not None and param_specs:
+        param_shapes = _spec_shapes(param_specs)
+        mesh_axes = factorize_mesh(cfg.num_nodes)
+    rep = analyze(
+        graph,
+        cluster,
+        schedule,
+        strict=args.strict,
+        param_shapes=param_shapes,
+        mesh_axes=mesh_axes,
+        family=family or "gpt2",
+        param_specs=param_specs if cfg.quantize == "int8" else None,
+    )
+    if schedule.failed:
+        print(f"note: scheduler failed {len(schedule.failed)} task(s) "
+              "under this memory regime (not a schedule defect)",
+              file=sys.stderr)
+    from .analysis import Severity
+
+    min_sev = Severity.INFO if args.verbose else Severity.WARNING
+    print(rep.render(min_severity=min_sev))
+    return rep.exit_code
+
+
 def cmd_sweep(args) -> int:
     from .eval.evaluator import Evaluator
 
@@ -981,6 +1034,23 @@ def main(argv=None) -> int:
     p.add_argument("--validate", action="store_true",
                    help="run the independent schedule checker (exit 2 on violations)")
     p.set_defaults(fn=cmd_schedule)
+
+    p = sub.add_parser(
+        "lint",
+        help="static analysis: lint a DAG + schedule + sharding config "
+             "without executing (exit 1 on errors)",
+    )
+    _add_common(p)
+    p.add_argument("--decode", action="store_true",
+                   help="lint the single-token decode-step DAG instead of "
+                        "the full forward")
+    p.add_argument("--strict", action="store_true",
+                   help="treat eviction-required residency (MEM002) as an "
+                        "error")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print info-level diagnostics (per-node peak "
+                        "residency)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("sweep", help="full evaluation sweep (CSV+PNG)")
     _add_common(p)

@@ -1,0 +1,172 @@
+"""Static analysis over graphs, schedules, clusters, and sharding specs.
+
+Multi-pass analyzer emitting structured :class:`Diagnostic` records with
+stable codes (``DAG001`` cycle, ``MEM003`` hbm-overcommit, ``SHD002``
+spec-rank-mismatch, ...) instead of ad-hoc exceptions — see
+docs/ANALYSIS.md for the full taxonomy.  Entry points:
+
+* :func:`analyze` — run every applicable pass, return one report (the
+  ``lint`` CLI subcommand is a thin wrapper over this);
+* :func:`pre_execution_gate` — the cheap corruption subset the backends
+  run before executing a schedule; raises :class:`AnalysisError`.
+  Opt out per-call with ``pre_analysis=False`` on the backend or globally
+  with ``DLS_SKIP_ANALYSIS=1`` in the environment;
+* ``core.validate.validate_schedule`` — the historical API, now a thin
+  shim over the schedule + memory passes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from ..core.cluster import Cluster
+from ..core.graph import TaskGraph
+from ..core.schedule import Schedule
+from .diagnostics import (
+    CODES,
+    AnalysisError,
+    AnalysisReport,
+    Diagnostic,
+    Severity,
+)
+from .graph_pass import analyze_graph
+from .memory_pass import analyze_memory
+from .pipeline_pass import analyze_pipeline
+from .quant_pass import analyze_quantization
+from .schedule_pass import analyze_schedule
+from .sharding_pass import analyze_sharding
+
+__all__ = [
+    "CODES",
+    "AnalysisError",
+    "AnalysisReport",
+    "Diagnostic",
+    "Severity",
+    "analyze",
+    "analyze_graph",
+    "analyze_memory",
+    "analyze_pipeline",
+    "analyze_quantization",
+    "analyze_schedule",
+    "analyze_sharding",
+    "gate_enabled",
+    "pre_execution_gate",
+]
+
+#: Setting this env var to anything non-empty (and not "0") disables the
+#: backend pre-execution gate globally.
+SKIP_ENV = "DLS_SKIP_ANALYSIS"
+
+
+def gate_enabled() -> bool:
+    return os.environ.get(SKIP_ENV, "0") in ("", "0")
+
+
+def analyze(
+    graph: TaskGraph,
+    cluster: Optional[Cluster] = None,
+    schedule: Optional[Schedule] = None,
+    *,
+    strict: bool = False,
+    param_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+    mesh_axes: Optional[Dict[str, int]] = None,
+    family: str = "gpt2",
+    seq_parallel: bool = False,
+    param_specs: Optional[Dict[str, Any]] = None,
+) -> AnalysisReport:
+    """Run every pass the provided inputs make applicable.
+
+    Graph hygiene always runs; schedule-consistency, memory, and pipeline
+    passes run when ``cluster`` and ``schedule`` are given; the sharding
+    pass runs when ``param_shapes`` + ``mesh_axes`` are given; the
+    quantization pass runs when ``param_specs`` is given.
+    """
+    rep = analyze_graph(graph)
+    if cluster is not None and schedule is not None:
+        rep.extend(analyze_schedule(graph, cluster, schedule))
+        rep.extend(analyze_memory(graph, cluster, schedule, strict=strict))
+        rep.extend(analyze_pipeline(graph, schedule))
+    if param_shapes is not None and mesh_axes is not None:
+        rep.extend(
+            analyze_sharding(
+                param_shapes,
+                mesh_axes,
+                family,
+                seq_parallel=seq_parallel,
+            )
+        )
+    if param_specs is not None:
+        rep.extend(analyze_quantization(graph, param_specs))
+    return rep
+
+
+# Schedules the backends accept by contract are a superset of what the
+# full analyzer calls clean: the device backend legalizes per-node order
+# inversions (``dispatch_order``) and drops tasks whose dependencies were
+# never placed (graceful degradation), and both backends accept schedules
+# covering only part of the graph.  The gate therefore checks only the
+# defects that would *corrupt* a replay or dispatch, per backend.
+_GATE_CODES = {
+    "sim": frozenset(
+        {"DAG001", "DAG002", "DAG005", "DAG007",
+         "SCH001", "SCH002", "SCH003", "SCH009", "PIP001", "PIP002"}
+    ),
+    "device": frozenset(
+        {"DAG001", "DAG002", "DAG005", "DAG007",
+         "SCH001", "SCH002", "SCH003"}
+    ),
+}
+
+
+def pre_execution_gate(
+    graph: TaskGraph,
+    cluster: Cluster,
+    schedule: Schedule,
+    backend: str = "sim",
+) -> Optional[AnalysisReport]:
+    """Cheap (O(V+E)) corruption check run by the backends before work.
+
+    Raises :class:`AnalysisError` when the schedule would corrupt this
+    backend's execution; returns the (possibly empty) report otherwise,
+    or ``None`` when the gate is disabled via ``DLS_SKIP_ANALYSIS``.
+    """
+    if not gate_enabled():
+        return None
+    codes = _GATE_CODES[backend]
+    rep = analyze_graph(graph)
+    rep.extend(analyze_schedule(graph, cluster, schedule))
+    if backend == "sim":
+        rep.extend(analyze_pipeline(graph, schedule))
+        # the replay indexes placement[tid] for every ordered task
+        placed = {t for ts in schedule.per_node.values() for t in ts}
+        for tid in schedule.assignment_order:
+            if tid not in placed:
+                rep.add(
+                    "SCH004",
+                    Severity.ERROR,
+                    f"assignment_order task {tid!r} has no placement",
+                    task=tid,
+                )
+                break
+        codes = codes | {"SCH004"}
+    gated = AnalysisReport(
+        [d for d in rep.diagnostics if d.code in codes]
+    )
+    gated.raise_if_errors()
+    return gated
+
+
+def _spec_shapes(specs: Optional[Dict[str, Any]]) -> Dict[str, Tuple[int, ...]]:
+    """Shape dict from a ModelDAG ``param_specs`` mapping; QParam entries
+    report their int8 payload's shape (the sharded axis layout)."""
+    from ..utils.quantize import QParam
+
+    out: Dict[str, Tuple[int, ...]] = {}
+    for name, spec in (specs or {}).items():
+        if isinstance(spec, QParam):
+            spec = spec.q
+        shape = getattr(spec, "shape", None)
+        if shape is not None:
+            out[name] = tuple(shape)
+    return out

@@ -1,0 +1,176 @@
+"""Structured diagnostics for the static-analysis passes.
+
+Every pass emits :class:`Diagnostic` records with a stable code (``DAG001``,
+``MEM003``, ...), a severity, and task/node/param provenance instead of
+raising ad-hoc exceptions.  A :class:`AnalysisReport` aggregates them and
+maps onto a process exit code for the ``lint`` CLI; the pre-execution gate
+in the backends raises :class:`AnalysisError` when a report contains
+errors (see analysis/__init__.py).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class Severity(enum.IntEnum):
+    """Ordered so ``max()`` over diagnostics yields the worst one."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error", not "Severity.ERROR"
+        return self.name.lower()
+
+
+#: The documented taxonomy: every code a pass may emit, with a short
+#: description.  docs/ANALYSIS.md mirrors this table; tests assert that
+#: emitted codes stay within it.
+CODES: Dict[str, str] = {
+    # -- graph hygiene (graph_pass) -------------------------------------
+    "DAG001": "dependency cycle",
+    "DAG002": "dependency on unknown task",
+    "DAG003": "duplicate dependency",
+    "DAG004": "task can never run: blocked behind a dependency cycle",
+    "DAG005": "negative memory or compute requirement",
+    "DAG006": "parameter used without a size declaration",
+    "DAG007": "conflicting parameter size declarations",
+    # -- schedule consistency (schedule_pass) ---------------------------
+    "SCH001": "per_node references unknown device",
+    "SCH002": "scheduled task not in graph",
+    "SCH003": "task placed on more than one node",
+    "SCH004": "assignment_order is not a permutation of placements",
+    "SCH005": "per-node order inconsistent with global order",
+    "SCH006": "task both completed and failed",
+    "SCH007": "task neither completed nor failed",
+    "SCH008": "completed/placement bookkeeping mismatch",
+    "SCH009": "task ordered before its dependency",
+    "SCH010": "completed task depends on a failed or unplaced task",
+    # -- memory feasibility (memory_pass) -------------------------------
+    "MEM001": "per-node no-eviction peak residency (informational)",
+    "MEM002": "no-eviction peak exceeds capacity: eviction required",
+    "MEM003": "hbm-overcommit: task cannot fit even with full eviction",
+    "MEM004": "parameter larger than the largest device",
+    # -- sharding consistency (sharding_pass) ---------------------------
+    "SHD001": "PartitionSpec names a mesh axis that does not exist",
+    "SHD002": "spec-rank-mismatch: PartitionSpec longer than param rank",
+    "SHD003": "dimension not divisible by mesh axis size",
+    "SHD004": "mesh axis used on more than one dimension of a spec",
+    "SHD005": "mesh axis shared between param and batch/activation specs",
+    # -- pipeline soundness (pipeline_pass) -----------------------------
+    "PIP001": "per-node order violates same-node stage dependency",
+    "PIP002": "cross-node deadlock in per-node execution orders",
+    # -- quantization dtype flow (quant_pass) ---------------------------
+    "QNT001": "QParam with wrong component dtypes",
+    "QNT002": "QParam scale shape matches no known layout",
+    "QNT003": "quantized param that should_quantize would reject",
+    "QNT004": "task param_bytes disagree with quantized size",
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding: stable code + severity + human message + provenance."""
+
+    code: str
+    severity: Severity
+    message: str
+    task: Optional[str] = None
+    node: Optional[str] = None
+    param: Optional[str] = None
+    #: machine-readable payload (e.g. {"peak_gb": 12.3}); not rendered.
+    data: Dict[str, Any] = field(default_factory=dict, compare=False)
+
+    def __post_init__(self) -> None:
+        if self.code not in CODES:
+            raise ValueError(f"unknown diagnostic code {self.code!r}")
+
+    def render(self) -> str:
+        where = "".join(
+            f" [{k}={v}]"
+            for k, v in (
+                ("task", self.task),
+                ("node", self.node),
+                ("param", self.param),
+            )
+            if v is not None
+        )
+        return f"{self.code} {self.severity}: {self.message}{where}"
+
+
+class AnalysisError(ValueError):
+    """Raised by the pre-execution gate when a report contains errors.
+
+    Subclasses ``ValueError`` so existing callers treating backend input
+    problems as value errors keep working.  Carries the offending report.
+    """
+
+    def __init__(self, report: "AnalysisReport"):
+        self.report = report
+        errs = report.errors
+        shown = "; ".join(d.render() for d in errs[:5])
+        more = f" (+{len(errs) - 5} more)" if len(errs) > 5 else ""
+        super().__init__(f"static analysis found {len(errs)} error(s): {shown}{more}")
+
+
+@dataclass
+class AnalysisReport:
+    """Aggregated diagnostics from one or more passes."""
+
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(
+        self,
+        code: str,
+        severity: Severity,
+        message: str,
+        **provenance: Any,
+    ) -> Diagnostic:
+        d = Diagnostic(code, severity, message, **provenance)
+        self.diagnostics.append(d)
+        return d
+
+    def extend(self, other: "AnalysisReport") -> "AnalysisReport":
+        self.diagnostics.extend(other.diagnostics)
+        return self
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.WARNING]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def has(self, code: str) -> bool:
+        return any(d.code == code for d in self.diagnostics)
+
+    def render(self, *, min_severity: Severity = Severity.INFO) -> str:
+        """Human-readable report, worst findings first."""
+        shown = [d for d in self.diagnostics if d.severity >= min_severity]
+        shown.sort(key=lambda d: (-int(d.severity), d.code))
+        lines = [d.render() for d in shown]
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        n_info = len(self.diagnostics) - n_err - n_warn
+        lines.append(
+            f"analysis: {n_err} error(s), {n_warn} warning(s), {n_info} info"
+        )
+        return "\n".join(lines)
+
+    def raise_if_errors(self) -> None:
+        if self.errors:
+            raise AnalysisError(self)

@@ -1,0 +1,111 @@
+"""Pass 2b — memory feasibility.
+
+Replays per-node HBM residency over the schedule timeline: a task whose
+own activation + parameter footprint exceeds its node's capacity can never
+run there even with perfect MRU-style eviction (``MEM003``, error); a node
+whose *no-eviction* peak exceeds capacity merely requires eviction
+(``MEM002``, warning — cache-aware policies like MRU legitimately rely on
+it; error under ``strict``).  Per-node peaks are always reported as
+``MEM001`` info diagnostics with a machine-readable ``peak_gb`` payload.
+
+Sizes come from the graph's ``param_bytes`` declarations (the same table
+``utils/costmodel.py`` and the schedulers consume); callers wanting XLA's
+authoritative compiled footprints run ``utils.hbm.preflight_task_memory``
+first — the pass then sees the raised ``memory_required`` values.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..core.cluster import Cluster
+from ..core.graph import DEFAULT_PARAM_GB, GB, TaskGraph
+from ..core.schedule import Schedule
+from .diagnostics import AnalysisReport, Severity
+from .schedule_pass import placement_of
+
+_EPS = 1e-9
+
+
+def _param_sizes_gb(graph: TaskGraph) -> Dict[str, float]:
+    """First-declared-wins size table, safe on unfrozen graphs (mirrors
+    the table ``freeze()`` fixes, without raising on conflicts — those are
+    DAG007's job)."""
+    sizes: Dict[str, float] = {}
+    for t in graph.tasks():
+        for p, nbytes in t.param_bytes.items():
+            sizes.setdefault(p, nbytes / GB)
+    return sizes
+
+
+def analyze_memory(
+    graph: TaskGraph,
+    cluster: Cluster,
+    schedule: Schedule,
+    strict: bool = False,
+) -> AnalysisReport:
+    rep = AnalysisReport()
+    sizes = _param_sizes_gb(graph)
+
+    def gb(p: str) -> float:
+        return sizes.get(p, DEFAULT_PARAM_GB)
+
+    # params that no device could ever hold alongside nothing else
+    if len(cluster) > 0:
+        biggest = max(d.total_memory for d in cluster)
+        for p in sorted(sizes):
+            if sizes[p] > biggest + _EPS:
+                rep.add(
+                    "MEM004",
+                    Severity.ERROR,
+                    f"param {p!r} is {sizes[p]:.2f} GB but the largest "
+                    f"device holds {biggest:.2f} GB",
+                    param=p,
+                )
+
+    placed = placement_of(graph, cluster, schedule, AnalysisReport())
+    resident: Dict[str, Dict[str, float]] = {d.node_id: {} for d in cluster}
+    peak = {d.node_id: 0.0 for d in cluster}
+    for tid in schedule.assignment_order:
+        nid = placed.get(tid)
+        if nid is None or tid not in graph:
+            continue
+        task = graph[tid]
+        cap = cluster[nid].total_memory
+        own = task.memory_required + sum(
+            gb(p) for p in task.params_needed
+        )
+        if own > cap + _EPS:
+            rep.add(
+                "MEM003",
+                Severity.ERROR,
+                f"{tid!r} needs {own:.2f} GB alone but {nid} has "
+                f"{cap:.2f} GB",
+                task=tid,
+                node=nid,
+                data={"own_gb": own, "cap_gb": cap},
+            )
+        for p in task.params_needed:
+            resident[nid].setdefault(p, gb(p))
+        now = sum(resident[nid].values()) + task.memory_required
+        peak[nid] = max(peak[nid], now)
+
+    for nid, pk in peak.items():
+        rep.add(
+            "MEM001",
+            Severity.INFO,
+            f"{nid} peak no-evict residency {pk:.2f} GB "
+            f"of {cluster[nid].total_memory:.2f} GB",
+            node=nid,
+            data={"peak_gb": pk},
+        )
+        if pk > cluster[nid].total_memory + _EPS:
+            rep.add(
+                "MEM002",
+                Severity.ERROR if strict else Severity.WARNING,
+                f"{nid} peak no-evict residency {pk:.2f} GB exceeds "
+                f"{cluster[nid].total_memory:.2f} GB",
+                node=nid,
+                data={"peak_gb": pk},
+            )
+    return rep

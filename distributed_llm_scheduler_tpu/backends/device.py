@@ -137,7 +137,7 @@ class DeviceBackend:
     task -> DeviceState -> real device.
     """
 
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, pre_analysis: bool = True):
         missing = [d.node_id for d in cluster if d.jax_device is None]
         if missing:
             raise ValueError(
@@ -145,6 +145,9 @@ class DeviceBackend:
                 "build the cluster with Cluster.from_jax_devices()"
             )
         self.cluster = cluster
+        # opt-out static pre-execution gate (see analysis/):
+        # pre_analysis=False per instance, DLS_SKIP_ANALYSIS=1 globally
+        self.pre_analysis = pre_analysis
         # fn object -> jitted fn; survives across execute() calls so
         # benchmark reruns don't pay compilation again
         self._jit_cache: Dict[Any, Callable[..., Any]] = {}
@@ -1132,6 +1135,12 @@ class DeviceBackend:
                 "reps > 1 amortizes over identical repeated runs; profile "
                 "mode fences per task and stream_params runs must start "
                 "cold — measure those with reps=1"
+            )
+        if self.pre_analysis:
+            from ..analysis import pre_execution_gate
+
+            pre_execution_gate(
+                graph, self.cluster, schedule, backend="device"
             )
         graph.freeze()
         no_fn = [t.task_id for t in graph if t.fn is None]

@@ -222,7 +222,10 @@ class Cluster:
         return cls(out)
 
     def __repr__(self) -> str:
-        return f"Cluster({len(self.devices)} devices, {self.total_memory():.1f}GB total)"
+        return (
+            f"Cluster({len(self.devices)} devices, "
+            f"{self.total_memory():.1f}GB total)"
+        )
 
 
 def estimate_cluster_memory_needed(graph) -> float:

@@ -1,26 +1,12 @@
 """Independent schedule validation: the framework's "race detector".
 
-The reference is single-threaded by construction, so it has no sanitizer
-(SURVEY.md §5.2); the TPU-native analog is an *independent checker pass*
-over a placed schedule — written against the :class:`Schedule` contract
-only, sharing no code with the policies it checks — that catches the
-failure modes a wrong scheduler would smuggle past the backends:
-
-* **dependency order**: backends execute per-node lists in order and the
-  replay reads each dependency's finish time in global assignment order; a
-  task ordered before one of its dependencies would silently under-wait
-  (``SimulatedBackend.execute`` skips deps it hasn't seen) or deadlock a
-  real dispatch.  Both the global order and every per-node list must be
-  dependency-consistent.
-* **placement integrity**: completed tasks placed exactly once, per-node
-  lists a partition of the global order, completed/failed disjoint and
-  exhaustive over placed work, no task completed while a dependency failed.
-* **memory feasibility**: a task whose own activation + parameter
-  footprint exceeds its node's capacity can never run there (hard
-  violation).  Peak no-eviction residency per node is also replayed; under
-  ``strict=True`` exceeding capacity is a violation, otherwise it is
-  reported as diagnostics (cache-aware policies like MRU legitimately rely
-  on eviction, which the Schedule does not record).
+Historical entry point, now a thin shim over the static-analysis
+subsystem (``analysis/``): :func:`validate_schedule` runs the
+schedule-consistency and memory-feasibility passes and re-shapes their
+structured diagnostics into the original :class:`ValidationReport`
+(message texts unchanged — callers and tests match on substrings).  New
+code should call :func:`analysis.analyze` directly for coded diagnostics;
+see docs/ANALYSIS.md for the taxonomy.
 """
 
 from __future__ import annotations
@@ -65,92 +51,19 @@ def validate_schedule(
     strict: bool = False,
 ) -> ValidationReport:
     """Check a schedule against the graph/cluster it claims to place."""
-    rep = ValidationReport()
-    v = rep.violations.append
+    from ..analysis import analyze_memory, analyze_schedule
+
     graph.freeze()
-
-    placed: Dict[str, str] = {}
-    for nid, tids in schedule.per_node.items():
-        if nid not in cluster:
-            v(f"per_node references unknown device {nid!r}")
-            continue
-        for tid in tids:
-            if tid not in graph:
-                v(f"{tid!r} on {nid} is not a graph task")
-            elif tid in placed:
-                v(f"{tid!r} placed on both {placed[tid]} and {nid}")
-            else:
-                placed[tid] = nid
-
-    # global order: a permutation of placed tasks
-    order = schedule.assignment_order
-    if sorted(order) != sorted(placed):
-        v("assignment_order is not a permutation of the placed tasks")
-    pos = {tid: i for i, tid in enumerate(order)}
-
-    # per-node lists must be subsequences of the global order
-    for nid, tids in schedule.per_node.items():
-        ranks = [pos[t] for t in tids if t in pos]
-        if ranks != sorted(ranks):
-            v(f"per_node[{nid}] order disagrees with assignment_order")
-
-    # completed/failed partition — and total coverage: a scheduler that
-    # silently DROPS tasks (or returns an empty schedule) must not validate
-    if schedule.completed & schedule.failed:
-        v("completed and failed sets overlap")
-    unaccounted = (
-        set(graph.task_ids()) - schedule.completed - schedule.failed
-    )
-    for tid in sorted(unaccounted)[:20]:
-        v(f"{tid!r} neither completed nor failed")
-    if len(unaccounted) > 20:
-        v(f"...and {len(unaccounted) - 20} more unaccounted tasks")
-    for tid in schedule.completed:
-        if tid not in placed:
-            v(f"completed task {tid!r} has no placement")
-    for tid in placed:
-        if tid not in schedule.completed:
-            v(f"placed task {tid!r} not marked completed")
-
-    # dependency order + failed-dependency propagation
-    for tid in placed:
-        for d in graph[tid].dependencies:
-            if d in schedule.failed:
-                v(f"{tid!r} completed but its dependency {d!r} failed")
-            elif d not in placed:
-                v(f"{tid!r} placed but its dependency {d!r} is unplaced")
-            elif pos.get(d, -1) > pos.get(tid, -1):
-                v(f"{tid!r} ordered before its dependency {d!r}")
-
-    # memory feasibility: hard per-task footprint + no-evict residency replay
-    resident: Dict[str, Dict[str, float]] = {d.node_id: {} for d in cluster}
-    peak = {d.node_id: 0.0 for d in cluster}
-    for tid in order:
-        nid = placed.get(tid)
-        if nid is None or tid not in graph:
-            continue
-        task = graph[tid]
-        cap = cluster[nid].total_memory
-        own = task.memory_required + sum(
-            graph.param_size_gb(p) for p in task.params_needed
-        )
-        if own > cap + 1e-9:
-            v(
-                f"{tid!r} needs {own:.2f} GB alone but {nid} has {cap:.2f} GB"
-            )
-        for p in task.params_needed:
-            resident[nid].setdefault(p, graph.param_size_gb(p))
-        now = sum(resident[nid].values()) + task.memory_required
-        peak[nid] = max(peak[nid], now)
-    for nid, pk in peak.items():
-        rep.peak_no_evict_gb[nid] = pk
-        if pk > cluster[nid].total_memory + 1e-9:
-            if strict:
-                v(
-                    f"{nid} peak no-evict residency {pk:.2f} GB exceeds "
-                    f"{cluster[nid].total_memory:.2f} GB"
-                )
-            else:
-                rep.requires_eviction.append(nid)
-
+    rep = ValidationReport()
+    consistency = analyze_schedule(graph, cluster, schedule)
+    memory = analyze_memory(graph, cluster, schedule, strict=strict)
+    # MEM004 (param larger than any device) is a graph-level finding the
+    # historical validator never made; the lint CLI surfaces it instead
+    for d in consistency.errors + memory.errors:
+        if d.code != "MEM004":
+            rep.violations.append(d.message)
+    for d in memory.by_code("MEM001"):
+        rep.peak_no_evict_gb[d.node] = d.data["peak_gb"]
+    if not strict:
+        rep.requires_eviction = [d.node for d in memory.by_code("MEM002")]
     return rep

@@ -4,7 +4,8 @@ The driver records ``BENCH_r{N}.json`` itself (bench.py); everything else
 measured — streaming-under-eviction, decode roofline + attribution +
 task-graph decode, the training-step DAG — is captured here in ONE
 sequential pass so a flaky tunnel session is used efficiently and every
-artifact carries the same platform provenance.  Each leg is independently guarded: one failure
+artifact carries the same platform provenance.  Each leg is
+independently guarded: one failure
 degrades that artifact to an ``{"error": ...}`` stub instead of losing
 the pass.
 

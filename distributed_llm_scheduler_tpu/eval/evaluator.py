@@ -100,7 +100,9 @@ class Evaluator:
         return Cluster.heterogeneous(needed * regime, n_nodes, rng=rng)
 
     # -- sweep -------------------------------------------------------------
-    def run_experiments(self, num_runs: int = 3, seed: int = 0) -> List[ExecutionReport]:
+    def run_experiments(
+        self, num_runs: int = 3, seed: int = 0
+    ) -> List[ExecutionReport]:
         """The reference's full sweep (simulation.py:365-416).
 
         Each run regenerates the workload with a distinct seed (workload
@@ -174,7 +176,9 @@ class Evaluator:
         df.to_csv(path, index=False)
         return path
 
-    def write_plots(self, path: str = "evaluation_results/scheduler_performance.png") -> str:
+    def write_plots(
+        self, path: str = "evaluation_results/scheduler_performance.png"
+    ) -> str:
         """4-panel figure: completion vs regime, LLM completion, makespan by
         DAG type, load balance (reference simulation.py:448-514)."""
         import os

@@ -351,7 +351,9 @@ def llama_config_from_hf(hf_config: Any, dtype: Any = jnp.float32):
     )
 
 
-def fit_params_to_dag(dag: Any, params: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+def fit_params_to_dag(
+    dag: Any, params: Dict[str, jnp.ndarray]
+) -> Dict[str, jnp.ndarray]:
     """Derive any DAG-build-specific params missing from a base checkpoint.
 
     Vocab-sharded builds (``build_gpt2_dag(vocab_shards=S)``) consume

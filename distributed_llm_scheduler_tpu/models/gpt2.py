@@ -117,7 +117,9 @@ def param_shapes(config: GPT2Config) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
 
 # -- per-op functions (task granularity of the reference DAG) ---------------
 
-def layer_norm(x: jax.Array, g: jax.Array, b: jax.Array, eps: float = 1e-5) -> jax.Array:
+def layer_norm(
+    x: jax.Array, g: jax.Array, b: jax.Array, eps: float = 1e-5
+) -> jax.Array:
     xf = x.astype(jnp.float32)
     mean = xf.mean(-1, keepdims=True)
     var = xf.var(-1, keepdims=True)

@@ -74,7 +74,8 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, jax.Array]:
     l{i}_w_gate/w_up/w_down, final_norm_g, lm_head``."""
     std = 0.02
     d, dtype = config.d_model, config.dtype
-    hd, nh, nkv, f = config.head_dim, config.n_heads, config.n_kv_heads, config.ffn_hidden
+    hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    f = config.ffn_hidden
     params: Dict[str, jax.Array] = {}
 
     def normal(key, shape, scale=std):
@@ -125,7 +126,8 @@ def embedding(input_ids: jax.Array, tok_emb: jax.Array) -> jax.Array:
 def rope_tables(T: int, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
     """(cos, sin) of shape (T, head_dim//2), float32.  Static-shape; XLA
     constant-folds these when they appear inside a jitted task fn."""
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta ** exponents)
     ang = jnp.arange(T, dtype=jnp.float32)[:, None] * inv_freq[None, :]
     return jnp.cos(ang), jnp.sin(ang)
 

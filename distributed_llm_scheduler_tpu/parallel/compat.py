@@ -1,0 +1,35 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+``shard_map`` is a top-level export on recent jax but lives in
+``jax.experimental.shard_map`` on older releases, and the replication
+check kwarg was renamed ``check_rep`` -> ``check_vma`` along the way.
+Callers use the new-style API; this wrapper adapts it downward.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+try:  # jax >= 0.5
+    from jax.lax import axis_size
+except ImportError:
+    from jax.lax import psum as _psum
+
+    def axis_size(axis_name):
+        # psum of a literal is folded to the (static) named-axis size
+        return _psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .compat import axis_size, shard_map
+
 
 def _block_scores(q, k, q_blk, kv_blk, blk_len):
     """Masked scores of one Q chunk against one K/V chunk.
@@ -53,7 +55,7 @@ def ring_attention(
     per-device shapes (B, H, T_local, hd).  Returns the local output chunk
     (B, H, T_local, hd).
     """
-    n_blocks = jax.lax.axis_size(axis_name)
+    n_blocks = axis_size(axis_name)
     my_blk = jax.lax.axis_index(axis_name)
     B, H, T, hd = q.shape
     fmax = jnp.finfo(jnp.float32)
@@ -104,7 +106,7 @@ def ring_attention_sharded(
     """Convenience wrapper: shard (B, H, T, hd) tensors over ``axis_name``
     on their sequence dim and run ring attention via shard_map."""
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec, spec, spec),

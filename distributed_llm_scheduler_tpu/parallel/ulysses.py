@@ -29,6 +29,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import mha as _fused_mha
+from .compat import axis_size, shard_map
 
 
 def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
@@ -62,7 +63,7 @@ def ulysses_attention(
     shapes (B, H, T_local, hd), H divisible by the axis size.  Returns the
     local sequence chunk (B, H, T_local, hd).
     """
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     H = q.shape[1]
     if H % sp != 0:
         raise ValueError(
@@ -86,7 +87,7 @@ def ulysses_attention_sharded(
     """Convenience wrapper: shard (B, H, T, hd) tensors over ``axis_name``
     on their sequence dim and run Ulysses attention via shard_map."""
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ulysses_attention, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

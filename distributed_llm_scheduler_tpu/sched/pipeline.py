@@ -80,7 +80,9 @@ class PipelineStageScheduler(BaseScheduler):
         self,
         graph: TaskGraph,
         devices: List[DeviceState],
-        stats: Optional[Tuple[List[str], List[float], List[float], List[Set[str]]]] = None,
+        stats: Optional[
+            Tuple[List[str], List[float], List[float], List[Set[str]]]
+        ] = None,
         reserved: Optional[List[float]] = None,
     ) -> Optional[List[int]]:
         """Return stage boundaries (k+1 indices into the group order; stage s

@@ -14,7 +14,9 @@ import os
 from typing import Any, Dict, Optional
 
 
-def save_params(params: Dict[str, Any], path: str, use_orbax: Optional[bool] = None) -> str:
+def save_params(
+    params: Dict[str, Any], path: str, use_orbax: Optional[bool] = None
+) -> str:
     """Save a flat param dict.  ``path`` is a directory for orbax, a ``.npz``
     file for the numpy fallback."""
     if use_orbax is None:

@@ -16,7 +16,9 @@ from typing import Optional, Tuple
 @dataclasses.dataclass
 class RunConfig:
     # workload
-    model: str = "gpt2"            # gpt2[-medium|-tiny] | llama[-8b|-tiny] | mixtral[-8x7b|-tiny] | llm | random | pipeline
+    # gpt2[-medium|-tiny] | llama[-8b|-tiny] | mixtral[-8x7b|-tiny]
+    # | llm | random | pipeline
+    model: str = "gpt2"
     batch: int = 1
     seq_len: int = 512
     microbatches: int = 1

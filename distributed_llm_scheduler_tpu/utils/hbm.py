@@ -36,7 +36,7 @@ def _key_of(fn: Any, pd_spec: Dict[str, Any], arg_specs: Tuple[Any, ...]):
     import jax
 
     leaves = jax.tree_util.tree_leaves((pd_spec, arg_specs))
-    return (id(fn), tuple((l.shape, str(l.dtype)) for l in leaves))
+    return (id(fn), tuple((x.shape, str(x.dtype)) for x in leaves))
 
 
 def preflight_task_memory(

@@ -36,7 +36,9 @@ def wall_timer() -> Iterator[Dict[str, float]]:
         out["seconds"] = time.perf_counter() - t0
 
 
-def compiled_cost_analysis(fn: Callable[..., Any], *example_args: Any) -> Dict[str, float]:
+def compiled_cost_analysis(
+    fn: Callable[..., Any], *example_args: Any
+) -> Dict[str, float]:
     """XLA's cost analysis (flops, bytes accessed) for ``fn`` on the example
     shapes — the compiler-side complement to measured timings."""
     import jax

@@ -1,0 +1,380 @@
+"""Static-analysis subsystem (analysis/): one failing fixture per pass,
+gate behavior on the backends, and a lint smoke test over every frontend
+DAG builder x the default scheduler (docs/ANALYSIS.md taxonomy)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, DeviceState, Task, TaskGraph
+from distributed_llm_scheduler_tpu.analysis import (
+    CODES,
+    AnalysisError,
+    Severity,
+    analyze,
+    analyze_graph,
+    analyze_memory,
+    analyze_pipeline,
+    analyze_quantization,
+    analyze_schedule,
+    analyze_sharding,
+    pre_execution_gate,
+)
+from distributed_llm_scheduler_tpu.core.schedule import Schedule
+
+
+def sched(per_node, completed=None, failed=None, order=None):
+    if order is None:
+        order = [t for tids in per_node.values() for t in tids]
+    return Schedule(
+        policy="manual",
+        per_node=per_node,
+        assignment_order=order,
+        completed=set(order) if completed is None else completed,
+        failed=failed or set(),
+    )
+
+
+# -- pass 1: graph hygiene --------------------------------------------------
+
+def test_graph_pass_cycle():
+    g = TaskGraph([
+        Task("a", 1.0, 1.0, ["c"], set()),
+        Task("b", 1.0, 1.0, ["a"], set()),
+        Task("c", 1.0, 1.0, ["b"], set()),
+        Task("waiter", 1.0, 1.0, ["c"], set()),
+    ])
+    rep = analyze_graph(g)
+    assert rep.exit_code == 1
+    (d,) = rep.by_code("DAG001")
+    assert d.severity == Severity.ERROR
+    assert set(d.data["tasks"]) == {"a", "b", "c"}
+    # the task waiting on the cycle is flagged as blocked, not cyclic
+    assert [x.task for x in rep.by_code("DAG004")] == ["waiter"]
+
+
+def test_graph_pass_dangling_duplicate_negative():
+    g = TaskGraph([
+        Task("a", -1.0, 1.0, ["ghost"], set()),
+        Task("b", 1.0, 1.0, ["a", "a"], set()),
+    ])
+    rep = analyze_graph(g)
+    assert rep.has("DAG002") and rep.has("DAG005")
+    assert rep.by_code("DAG003")[0].severity == Severity.WARNING
+    assert rep.exit_code == 1
+
+
+def test_graph_pass_param_sizes():
+    g = TaskGraph([
+        Task("a", 1.0, 1.0, [], {"p", "q"}, param_bytes={"p": 100}),
+        Task("b", 1.0, 1.0, ["a"], {"p"}, param_bytes={"p": 200}),
+    ])
+    rep = analyze_graph(g)
+    assert rep.has("DAG007")           # p: 100 vs 200 bytes
+    assert rep.by_code("DAG006")[0].param == "q"
+    clean = analyze_graph(TaskGraph([Task("a", 1.0, 1.0, [], {"p"})]))
+    assert clean.ok and not clean.has("DAG006")  # no sizes declared at all
+
+
+# -- pass 2: schedule consistency + memory feasibility ----------------------
+
+def two_caps(cap0=1.0, cap1=1.0):
+    return Cluster([DeviceState("n0", cap0), DeviceState("n1", cap1)])
+
+
+def test_schedule_pass_catches_corruption():
+    g = TaskGraph([
+        Task("a", 0.1, 1.0, [], set()),
+        Task("b", 0.1, 1.0, ["a"], set()),
+    ]).freeze()
+    rep = analyze_schedule(
+        g, two_caps(), sched({"n0": ["b", "a"]})
+    )
+    assert rep.has("SCH009")  # b ordered before its dependency a
+    rep2 = analyze_schedule(
+        g, two_caps(), sched({"n0": ["a", "b"], "n1": ["a"], "bogus": []})
+    )
+    assert rep2.has("SCH001") and rep2.has("SCH003")
+
+
+def test_memory_pass_overcommit():
+    g = TaskGraph([
+        Task("big", 5.0, 1.0, [], {"w"}, param_bytes={"w": 2 << 30}),
+    ]).freeze()
+    rep = analyze_memory(g, two_caps(), sched({"n0": ["big"]}))
+    assert rep.exit_code == 1
+    (d,) = rep.by_code("MEM003")
+    assert d.task == "big" and d.node == "n0"
+    assert d.data["own_gb"] > d.data["cap_gb"]
+
+
+def test_memory_pass_eviction_warning_and_strict():
+    # two 0.6 GB params through one 1.0 GB node: each task fits alone,
+    # the no-evict residency does not
+    nbytes = int(0.6 * (1 << 30))
+    g = TaskGraph([
+        Task("a", 0.0, 1.0, [], {"p1"}, param_bytes={"p1": nbytes}),
+        Task("b", 0.0, 1.0, ["a"], {"p2"}, param_bytes={"p2": nbytes}),
+    ]).freeze()
+    s = sched({"n0": ["a", "b"]})
+    rep = analyze_memory(g, two_caps(), s)
+    assert rep.ok and rep.has("MEM002")
+    assert rep.by_code("MEM002")[0].severity == Severity.WARNING
+    strict = analyze_memory(g, two_caps(), s, strict=True)
+    assert strict.exit_code == 1
+
+
+def test_memory_pass_oversized_param():
+    g = TaskGraph([
+        Task("a", 0.0, 1.0, [], {"w"}, param_bytes={"w": 8 << 30}),
+    ]).freeze()
+    rep = analyze_memory(g, two_caps(), sched({"n0": []}, completed=set()))
+    assert rep.by_code("MEM004")[0].param == "w"
+
+
+# -- pass 3: sharding consistency -------------------------------------------
+
+MESH = {"dp": 2, "tp": 4, "sp": 1}
+
+
+def test_sharding_pass_rank_mismatch():
+    # attn_qkv_w expects P(None, "tp") — a 1-D tensor cannot carry it
+    rep = analyze_sharding({"attn_qkv_w": (768,)}, MESH, family="gpt2")
+    assert rep.exit_code == 1
+    assert rep.by_code("SHD002")[0].param == "attn_qkv_w"
+
+
+def test_sharding_pass_unknown_axis_and_divisibility():
+    rep = analyze_sharding(
+        {"attn_qkv_w": (768, 2304)}, {"dp": 2}, family="gpt2"
+    )
+    assert rep.has("SHD001")  # no "tp" axis in the mesh
+    rep2 = analyze_sharding(
+        {"attn_qkv_w": (768, 2306)}, MESH, family="gpt2"
+    )
+    assert rep2.has("SHD003")  # 2306 % 4 != 0
+    clean = analyze_sharding(
+        {"attn_qkv_w": (768, 2304), "ln_f_g": (768,)}, MESH, family="gpt2"
+    )
+    assert clean.ok
+
+
+def test_sharding_pass_conflicting_axis_reuse():
+    rep = analyze_sharding(
+        {"attn_qkv_w": (768, 2304)},
+        MESH,
+        family="gpt2",
+        batch_spec=("tp", None),  # tp shards params AND the batch
+    )
+    assert rep.has("SHD005")
+    assert rep.exit_code == 1
+
+
+# -- pass 4: pipeline soundness ---------------------------------------------
+
+def chain4():
+    return TaskGraph([
+        Task("t1", 0.1, 1.0, [], set()),
+        Task("t2", 0.1, 1.0, ["t1"], set()),
+        Task("t3", 0.1, 1.0, [], set()),
+        Task("t4", 0.1, 1.0, ["t3"], set()),
+    ]).freeze()
+
+
+def test_pipeline_pass_deadlock():
+    # n0 runs t4 before t1, n1 runs t2 before t3: circular wait
+    # t1 -> t2 (dep), t2 -> t3 (n1 order), t3 -> t4 (dep), t4 -> t1 (n0)
+    s = sched({"n0": ["t4", "t1"], "n1": ["t2", "t3"]})
+    rep = analyze_pipeline(chain4(), s)
+    assert rep.exit_code == 1
+    (d,) = rep.by_code("PIP002")
+    assert set(d.data["tasks"]) == {"t1", "t2", "t3", "t4"}
+
+
+def test_pipeline_pass_same_node_inversion():
+    s = sched({"n0": ["t2", "t1"], "n1": ["t3", "t4"]})
+    rep = analyze_pipeline(chain4(), s)
+    assert rep.by_code("PIP001")[0].task == "t2"
+
+
+def test_pipeline_pass_accepts_wrapped_stages():
+    # virtual-stage style wrap (stage s on device s % 2) is NOT a deadlock
+    s = sched({"n0": ["t1", "t3"], "n1": ["t2", "t4"]})
+    assert analyze_pipeline(chain4(), s).ok
+
+
+# -- pass 5: quantization dtype flow ----------------------------------------
+
+def qgraph(nbytes):
+    return TaskGraph([
+        Task("a", 0.1, 1.0, [], {"w"}, param_bytes={"w": nbytes}),
+    ]).freeze()
+
+
+def test_quant_pass_dtypes_and_layout():
+    from distributed_llm_scheduler_tpu.utils.quantize import QParam
+
+    bad_dtype = {
+        "w": QParam(
+            q=np.zeros((128, 64), np.float32),     # should be int8
+            scale=np.zeros((1, 64), np.float32),
+        )
+    }
+    rep = analyze_quantization(qgraph(1), bad_dtype)
+    assert rep.exit_code == 1 and rep.has("QNT001")
+
+    bad_scale = {
+        "w": QParam(
+            q=np.zeros((128, 64), np.int8),
+            scale=np.zeros((7, 7), np.float32),    # no known layout
+        )
+    }
+    rep2 = analyze_quantization(qgraph(1), bad_scale)
+    assert rep2.exit_code == 1 and rep2.has("QNT002")
+
+
+def test_quant_pass_bytes_and_should_quantize():
+    from distributed_llm_scheduler_tpu.utils.quantize import (
+        QParam,
+        qparam_bytes,
+    )
+
+    q = np.zeros((128, 64), np.int8)
+    spec = {"w": QParam(q=q, scale=np.zeros((1, 64), np.float32))}
+    ok = analyze_quantization(qgraph(qparam_bytes(q)), spec)
+    assert ok.ok
+    wrong = analyze_quantization(qgraph(128 * 64 * 4), spec)
+    assert wrong.has("QNT004")
+
+    tiny = {
+        "w": QParam(
+            q=np.zeros((4, 4), np.int8), scale=np.zeros((1, 4), np.float32)
+        )
+    }
+    rep = analyze_quantization(qgraph(qparam_bytes(tiny["w"].q)), tiny)
+    assert rep.ok and rep.has("QNT003")  # warning only
+
+
+# -- real quantized DAG stays clean -----------------------------------------
+
+def test_quantize_dag_output_lints_clean():
+    from distributed_llm_scheduler_tpu.utils.config import RunConfig
+    from distributed_llm_scheduler_tpu.utils.quantize import QParam
+
+    dag = RunConfig(model="gpt2-tiny", quantize="int8").build_graph()
+    assert any(isinstance(s, QParam) for s in dag.param_specs.values())
+    rep = analyze_quantization(dag.graph, dag.param_specs)
+    assert rep.ok, rep.render()
+
+
+# -- pre-execution gate ------------------------------------------------------
+
+def corrupted():
+    g = TaskGraph([
+        Task("a", 0.1, 1.0, [], set()),
+        Task("b", 0.1, 1.0, ["a"], set()),
+    ]).freeze()
+    return g, two_caps(), sched({"n0": ["b", "a"]})
+
+
+def test_gate_raises_on_corruption_sim():
+    g, cl, s = corrupted()
+    with pytest.raises(AnalysisError) as e:
+        pre_execution_gate(g, cl, s, backend="sim")
+    assert e.value.report.has("SCH009")
+    assert isinstance(e.value, ValueError)
+
+
+def test_gate_device_is_lenient_where_dispatch_legalizes():
+    # dispatch_order legalizes per-node inversions on the device backend;
+    # the device gate only rejects hard corruption (here: none)
+    g, cl, s = corrupted()
+    assert pre_execution_gate(g, cl, s, backend="device") is not None
+    bad = sched({"n0": ["a"], "n1": ["a", "b"]})  # duplicate placement
+    with pytest.raises(AnalysisError):
+        pre_execution_gate(g, cl, bad, backend="device")
+
+
+def test_gate_env_opt_out(monkeypatch):
+    g, cl, s = corrupted()
+    monkeypatch.setenv("DLS_SKIP_ANALYSIS", "1")
+    assert pre_execution_gate(g, cl, s, backend="sim") is None
+
+
+def test_sim_backend_runs_the_gate(monkeypatch):
+    from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
+
+    g, cl, s = corrupted()
+    with pytest.raises(AnalysisError):
+        SimulatedBackend(fidelity="full").execute(g, cl, s)
+    # per-instance opt out restores the old (crash-or-garbage) behavior;
+    # the replay itself still raises on the unknown-order placement or
+    # produces *a* report — either way no AnalysisError
+    rep = SimulatedBackend(fidelity="full", pre_analysis=False).execute(
+        g, cl, s
+    )
+    assert rep.makespan >= 0.0
+
+
+def test_gate_accepts_every_policy_output():
+    from distributed_llm_scheduler_tpu.frontend.generators import (
+        generate_llm_dag,
+    )
+    from distributed_llm_scheduler_tpu.sched.policies import (
+        ALL_SCHEDULERS,
+        get_scheduler,
+    )
+
+    graph = generate_llm_dag(num_layers=4, num_heads=4, seed=3)
+    for name in ALL_SCHEDULERS:
+        cluster = Cluster.heterogeneous(20.0, 4)
+        s = get_scheduler(name).schedule(graph, cluster)
+        for backend in ("sim", "device"):
+            rep = pre_execution_gate(graph, cluster, s, backend=backend)
+            assert rep is not None and rep.ok, (name, backend)
+
+
+# -- orchestration + CLI -----------------------------------------------------
+
+def test_analyze_runs_applicable_passes():
+    g, cl, s = corrupted()
+    rep = analyze(g, cl, s, param_shapes={"attn_qkv_w": (768,)},
+                  mesh_axes=MESH, family="gpt2")
+    assert rep.has("SCH009") and rep.has("SHD002") and rep.has("MEM001")
+    assert {d.code for d in rep.diagnostics} <= set(CODES)
+
+
+@pytest.mark.parametrize(
+    "argv",
+    [
+        ["lint", "--model", "gpt2-tiny"],
+        ["lint", "--model", "gpt2-tiny", "--train-step"],
+        ["lint", "--model", "gpt2-tiny", "--decode"],
+        ["lint", "--model", "gpt2-tiny", "--quantize", "int8"],
+        ["lint", "--model", "llama-tiny"],
+        ["lint", "--model", "mixtral-tiny"],
+        ["lint", "--model", "mixtral-tiny", "--routed"],
+        ["lint", "--model", "llm"],
+        ["lint", "--model", "random"],
+        ["lint", "--model", "pipeline", "--scheduler", "pipeline"],
+    ],
+    ids=lambda a: " ".join(a[1:]),
+)
+def test_lint_cli_clean_on_every_builder(argv):
+    from distributed_llm_scheduler_tpu.__main__ import main
+
+    assert main(argv) == 0
+
+
+def test_lint_cli_flags_failed_fit(capsys):
+    from distributed_llm_scheduler_tpu.__main__ import main
+
+    # 0.05 GB nodes cannot hold gpt2-tiny tasks: scheduler fails tasks,
+    # lint still reports cleanly (graceful degradation is not corruption)
+    rc = main([
+        "lint", "--model", "llm", "--hbm-gb", "0.05", "--num-nodes", "2"
+    ])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "failed" in out.err

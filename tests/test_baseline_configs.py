@@ -12,7 +12,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 import pytest
 
-from distributed_llm_scheduler_tpu import Cluster, DeviceState, get_scheduler, validate_schedule
+from distributed_llm_scheduler_tpu import (
+    Cluster,
+    DeviceState,
+    get_scheduler,
+    validate_schedule,
+)
 from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
 from distributed_llm_scheduler_tpu.sched.heft import HEFTScheduler
 from distributed_llm_scheduler_tpu.sched.pipeline import PipelineStageScheduler

@@ -12,7 +12,11 @@ from distributed_llm_scheduler_tpu.frontend.generators import generate_llm_dag
 @pytest.fixture(scope="module")
 def small_sweep():
     ev = Evaluator(
-        workloads={"llm_small": lambda seed=0: generate_llm_dag(num_layers=2, seed=seed)},
+        workloads={
+            "llm_small": lambda seed=0: generate_llm_dag(
+                num_layers=2, seed=seed
+            )
+        },
         node_counts=(2, 4),
         memory_regimes=(1.0, 0.8),
     )

@@ -2,7 +2,13 @@
 
 import pytest
 
-from distributed_llm_scheduler_tpu import Cluster, DeviceState, Task, TaskGraph, get_scheduler
+from distributed_llm_scheduler_tpu import (
+    Cluster,
+    DeviceState,
+    Task,
+    TaskGraph,
+    get_scheduler,
+)
 from distributed_llm_scheduler_tpu.backends.sim import (
     LinkModel,
     SimulatedBackend,
