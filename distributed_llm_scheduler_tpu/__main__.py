@@ -455,6 +455,10 @@ def _visualize_menu(args, cfg) -> int:
             g2 = getattr(d2, "graph", d2)
             cluster = cfg.build_cluster()
             schedule = sched_cls.schedule(g2, cluster)
+            if schedule.failed:
+                print(f"{policy}: {len(schedule.failed)} tasks failed to "
+                      "place; no gantt", file=sys.stderr)
+                continue
             _replay_backend(cfg).execute(g2, cluster, schedule)
             print("gantt ->", visualize_schedule(
                 schedule, f"{cfg.out_dir}/{g2.name}.{policy}.gantt.png",

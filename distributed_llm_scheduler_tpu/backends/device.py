@@ -36,6 +36,7 @@ in-process "multi-node" strategy (SURVEY.md §4).
 from __future__ import annotations
 
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -63,8 +64,21 @@ class DeviceReport:
     # per-device HBM peaks, when the platform reports memory_stats
     peak_hbm_bytes: Dict[str, int] = field(default_factory=dict)
     # executable launches issued (== placed tasks per-task; == segments
-    # under segment fusion)
+    # under segment fusion; == plan steps — coalesced groups count once —
+    # under planned dispatch)
     n_dispatches: int = 0
+    # host wall seconds spent inside the dispatch loop, per rep (launch +
+    # staging; end-of-run fence excluded).  Launches return at enqueue, so
+    # on async platforms this IS the host-side dispatch overhead the
+    # planned path exists to shrink; on platforms where a launch can
+    # block on device compute it is an upper bound.
+    dispatch_overhead_s: float = 0.0
+    # per-rep breakdown of the loop wall: planned dispatch reports
+    # {loop_s, stage_s (input placement + batched transfers), launch_s};
+    # the legacy paths report {loop_s}
+    dispatch_phases: Dict[str, float] = field(default_factory=dict)
+    # True when the run used the pre-planned fast path (dispatch_plan)
+    planned: bool = False
     # execute(keep_outputs=True): per-task outputs retained for elastic
     # recovery (every executed task per-task; segment exports under
     # segment fusion).  Keys feed reschedule()/execute(ext_outputs=...)
@@ -97,6 +111,11 @@ class DeviceReport:
             "param_gb_placed": self.total_param_gb_placed,
             "compile_s": self.compile_s,
             "n_dispatches": self.n_dispatches,
+            "dispatch_overhead_ms": self.dispatch_overhead_s * 1e3,
+            "dispatch_phases_ms": {
+                k: v * 1e3 for k, v in self.dispatch_phases.items()
+            },
+            "planned": self.planned,
             "peak_hbm_gb": {
                 k: v / 1024**3 for k, v in self.peak_hbm_bytes.items()
             },
@@ -151,11 +170,20 @@ class DeviceBackend:
         # fn object -> jitted fn; survives across execute() calls so
         # benchmark reruns don't pay compilation again
         self._jit_cache: Dict[Any, Callable[..., Any]] = {}
+        # (fn object, donate_argnums) -> jitted donating variant; separate
+        # from _jit_cache so tasks sharing one fn but dying-buffer patterns
+        # that differ never collide
+        self._donate_jit_cache: Dict[Tuple[Any, Tuple[int, ...]], Any] = {}
         # graph -> {(tids, exports): jitted segment fn}; weak so a dead
         # graph releases its compiled segments
         import weakref
 
         self._seg_cache: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+        # graph -> {(tids, exports, donate_argnums): jitted coalesced
+        # launch group} (dispatch_plan coalescing); weak like _seg_cache
+        self._group_cache: "weakref.WeakKeyDictionary" = (
             weakref.WeakKeyDictionary()
         )
 
@@ -489,22 +517,59 @@ class DeviceBackend:
             return out
 
     # -- compilation -------------------------------------------------------
-    def _jitted(self, graph: TaskGraph, tid: str):
+    def _jitted(self, graph: TaskGraph, tid: str,
+                donate_argnums: Tuple[int, ...] = ()):
         """One jitted callable per distinct fn *object*: tasks that share a
         fn (all layers' ln1 via param_alias) share the jit wrapper, so the
         per-layer compile multiplicity disappears.  XLA still compiles one
         executable per placement device (input sharding is part of the
-        cache key) — that per-device cost is inherent."""
+        cache key) — that per-device cost is inherent.
+
+        ``donate_argnums`` (planned dispatch) selects a donating variant,
+        cached per (fn, pattern) so differing dying-buffer patterns never
+        collide; the empty pattern is the shared plain cache."""
         task = graph[tid]
         if task.fn is None:
             raise ValueError(
                 f"task {tid!r} has no fn; this graph is schedule-only "
                 "(synthetic DAGs execute on the simulated backend)"
             )
+        if donate_argnums:
+            key = (task.fn, donate_argnums)
+            fn = self._donate_jit_cache.get(key)
+            if fn is None:
+                fn = jax.jit(task.fn, donate_argnums=donate_argnums)
+                self._donate_jit_cache[key] = fn
+            return fn
         fn = self._jit_cache.get(task.fn)
         if fn is None:
             fn = jax.jit(task.fn)
             self._jit_cache[task.fn] = fn
+        return fn
+
+    def _grouped_jitted(
+        self,
+        graph: TaskGraph,
+        tids: Tuple[str, ...],
+        exports: Tuple[str, ...],
+        donate_argnums: Tuple[int, ...] = (),
+    ):
+        """Jitted coalesced launch group (dispatch_plan): ``tids`` run in
+        order inside ONE executable, ``optimization_barrier`` between
+        members keeping per-task numerics bit-identical to separate
+        launches.  Cached per (graph, tids, exports, donate pattern) —
+        same keying rationale as ``_segment_callable``."""
+        per_graph = self._group_cache.setdefault(graph, {})
+        key = (tids, exports, donate_argnums)
+        fn = per_graph.get(key)
+        if fn is None:
+            from .dispatch_plan import _build_group_fn
+
+            fn = jax.jit(
+                _build_group_fn(graph, tids, exports),
+                donate_argnums=donate_argnums or None,
+            )
+            per_graph[key] = fn
         return fn
 
     def warmup(
@@ -813,7 +878,10 @@ class DeviceBackend:
             List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]
         ] = None,
         order: Optional[List[str]] = None,
-    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any]]:
+    ) -> Tuple[
+        Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any],
+        Dict[str, float],
+    ]:
         """Segment-fused execution: same placement, one launch per segment.
         Tasks with failed upstreams are dropped at segment-build time (host
         side), preserving fail-and-continue.  Cross-segment inputs are
@@ -874,6 +942,7 @@ class DeviceBackend:
         outputs: Dict[str, Any] = dict(ext_outputs or {})
         transfer_edges = 0
         transfer_bytes = 0
+        t_loop0 = time.perf_counter()
         for seg_i, (node, tids, exports) in enumerate(segments):
             dev = self.cluster[node].jax_device
             union: Dict[str, Any] = {}
@@ -914,6 +983,7 @@ class DeviceBackend:
                 streamer.note_task(
                     node, list(union_names), seg_out[exports[-1]]
                 )
+        loop_s = time.perf_counter() - t_loop0
 
         n_fences = 0
         last_on_device: Dict[str, Any] = {}
@@ -933,7 +1003,7 @@ class DeviceBackend:
         }
         return (
             final, {}, transfer_edges, transfer_bytes, n_fences,
-            len(segments), executed,
+            len(segments), executed, {"loop_s": loop_s},
         )
 
     # -- execution ---------------------------------------------------------
@@ -948,7 +1018,10 @@ class DeviceBackend:
         streamer: Optional["DeviceBackend._ParamStreamer"] = None,
         fence: bool = True,
         order: Optional[List[str]] = None,
-    ) -> Tuple[Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any]]:
+    ) -> Tuple[
+        Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any],
+        Dict[str, float],
+    ]:
         placement = schedule.placement
         # ext_outputs seed the value table: surviving outputs of an earlier
         # (partial) run whose producers are not in this graph — the elastic
@@ -967,6 +1040,7 @@ class DeviceBackend:
         # task (64 roots on the flagship DAG re-placed the same array 64
         # times per rep through the tunnel)
         input_on: Dict[str, Any] = {}
+        t_loop0 = time.perf_counter()
         for tid in order:
             if tid not in placement:
                 continue  # failed task: skip (fail-and-continue semantics)
@@ -1021,6 +1095,8 @@ class DeviceBackend:
                     node_id, [g for _, g in task.param_items()], out
                 )
 
+        loop_s = time.perf_counter() - t_loop0
+
         # fence ALL dispatched work (not just the topologically-last task:
         # multi-leaf graphs and skipped tails would otherwise under-measure).
         # block_until_ready first, then a per-device readback fence:
@@ -1042,7 +1118,7 @@ class DeviceBackend:
         }
         return (
             final, timings, transfer_edges, transfer_bytes, n_fences,
-            len(outputs) - n_ext, executed,
+            len(outputs) - n_ext, executed, {"loop_s": loop_s},
         )
 
     def execute(
@@ -1060,8 +1136,37 @@ class DeviceBackend:
         stream_lookahead: int = 8,
         reps: int = 1,
         rebatch: bool = True,
+        planned: Optional[bool] = None,
+        coalesce: bool = False,
+        donate: Optional[bool] = None,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
+
+        ``planned`` selects the pre-planned fast dispatch path
+        (:mod:`.dispatch_plan`): an immutable per-task plan built at
+        warmup (resolved executables, prebuilt param bindings, integer
+        value-table indices, batched per-launch ``device_put`` staging),
+        so the hot loop issues only cached-executable calls.  Default
+        (``None``) auto-enables it whenever compatible — ``profile``
+        (needs per-task timing hooks), ``stream_params`` (param residency
+        changes mid-run), and ``segments`` (already fused) keep the
+        legacy paths.  Placement, dispatch order, transfer counting, and
+        the end-of-run fence are identical to the legacy loop; outputs
+        are bit-identical.
+
+        ``donate`` (planned only): donate intermediate buffers that die
+        after their last same-device consumer via ``donate_argnums``.
+        Default probes the platform (donation is honored on CPU and TPU);
+        forced off by ``keep_outputs`` (retained outputs must outlive the
+        run — passing ``donate=True`` with ``keep_outputs`` raises).
+
+        ``coalesce`` (planned only, opt-in): fuse runs of consecutive
+        same-device tasks whose non-leading members consume only
+        values produced inside the run into ONE launch, with
+        ``optimization_barrier`` between members so per-task outputs stay
+        bit-identical.  Opt-in because host-side effects inside task fns
+        (``jax.debug.callback(ordered=False)``) lose their per-launch
+        ordering inside a single XLA program.
 
         ``reps > 1`` dispatches the whole placed run ``reps`` times
         back-to-back and fences ONCE at the end; ``makespan_s`` is then
@@ -1128,6 +1233,30 @@ class DeviceBackend:
             raise ValueError(
                 "profile=True needs per-task dispatch; run without segments"
             )
+        if planned is None:
+            planned = not (profile or stream_params or segments)
+        elif planned and (profile or stream_params or segments):
+            raise ValueError(
+                "planned dispatch is incompatible with profile (per-task "
+                "timing hooks), stream_params (param residency changes "
+                "mid-run), and segments (already fused)"
+            )
+        if coalesce and not planned:
+            raise ValueError("coalesce=True requires the planned path")
+        if donate and keep_outputs:
+            raise ValueError(
+                "donate=True deletes dying intermediates; keep_outputs "
+                "must retain them — drop one of the two"
+            )
+        if planned:
+            from .dispatch_plan import donation_supported
+
+            if donate is None:
+                donate = donation_supported() and not keep_outputs
+        elif donate:
+            raise ValueError("donate=True requires the planned path")
+        else:
+            donate = False
         if reps < 1:
             raise ValueError(f"reps must be >= 1, got {reps}")
         if reps > 1 and (profile or stream_params):
@@ -1195,24 +1324,55 @@ class DeviceBackend:
             # still handles drop-filter divergence
             segments_pre = self.build_segments(graph, schedule, order_once)
 
+        # planned fast path: precompute the immutable dispatch plan at
+        # warmup time (resolved executables, prebuilt param bindings,
+        # slot-indexed staging, donation patterns) so the timed loop does
+        # no per-task bookkeeping at all
+        plan = None
+        if planned:
+            from .dispatch_plan import DispatchPlan
+
+            plan = DispatchPlan.build(
+                self, graph, schedule, order_once, placed,
+                ext_keys=tuple(ext_outputs or ()),
+                donate=donate, coalesce=coalesce,
+                keep_outputs=keep_outputs,
+            )
+
         compile_s = 0.0
         if warmup:
-            # a throwaway streamer for the warmup pass: jit caches warm up,
-            # and the timed run's streamer starts cold (capacity misses are
-            # the thing being measured)
-            compile_s = self.warmup(
-                graph, schedule, placed, graph_input, segments=segments,
-                ext_outputs=ext_outputs,
-                streamer=(
-                    self._ParamStreamer(
-                        self.cluster, params, plan=stream_plan,
-                        lookahead=stream_lookahead,
+            if plan is not None:
+                # one full planned execution: jits every resolved
+                # executable (donating variants and coalesced groups
+                # included) and fills the static transfer-byte table.
+                # XLA warns once per lowering when a donated buffer's
+                # shape matches no output; the donation is still honored
+                # (the buffer is freed), so the warning is noise here.
+                t0 = time.perf_counter()
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable",
                     )
-                    if stream_params else None
-                ),
-                rebatch=rebatch,
-                segments_pre=segments_pre,
-            )
+                    plan.run(graph_input, ext_outputs, fence=True)
+                compile_s = time.perf_counter() - t0
+            else:
+                # a throwaway streamer for the warmup pass: jit caches warm
+                # up, and the timed run's streamer starts cold (capacity
+                # misses are the thing being measured)
+                compile_s = self.warmup(
+                    graph, schedule, placed, graph_input, segments=segments,
+                    ext_outputs=ext_outputs,
+                    streamer=(
+                        self._ParamStreamer(
+                            self.cluster, params, plan=stream_plan,
+                            lookahead=stream_lookahead,
+                        )
+                        if stream_params else None
+                    ),
+                    rebatch=rebatch,
+                    segments_pre=segments_pre,
+                )
 
         # fence round-trip, re-measured per execute (outside the timed
         # region): tunnel RTT demonstrably changes across reconnects, so a
@@ -1230,25 +1390,39 @@ class DeviceBackend:
             if stream_params else None
         )
         t0 = time.perf_counter()
+        loop_s_total = 0.0
+        phases_total: Dict[str, float] = {}
         for r in range(reps):
             fence = r == reps - 1  # intermediate reps queue without fencing
-            if segments:
-                output, timings, tedges, tbytes, n_fences, n_disp, touts = (
-                    self._run_segmented(
-                        graph, schedule, placed, graph_input, ext_outputs,
-                        fence=fence, rebatch=rebatch, streamer=streamer,
-                        segments_pre=segments_pre, order=order_once,
-                    )
+            if plan is not None:
+                (
+                    output, timings, tedges, tbytes, n_fences, n_disp,
+                    touts, phases,
+                ) = plan.run(graph_input, ext_outputs, fence=fence)
+            elif segments:
+                (
+                    output, timings, tedges, tbytes, n_fences, n_disp,
+                    touts, phases,
+                ) = self._run_segmented(
+                    graph, schedule, placed, graph_input, ext_outputs,
+                    fence=fence, rebatch=rebatch, streamer=streamer,
+                    segments_pre=segments_pre, order=order_once,
                 )
             else:
-                output, timings, tedges, tbytes, n_fences, n_disp, touts = (
-                    self._run(
-                        graph, schedule, placed, graph_input, profile,
-                        ext_outputs, streamer, fence=fence, order=order_once,
-                    )
+                (
+                    output, timings, tedges, tbytes, n_fences, n_disp,
+                    touts, phases,
+                ) = self._run(
+                    graph, schedule, placed, graph_input, profile,
+                    ext_outputs, streamer, fence=fence, order=order_once,
                 )
+            loop_s_total += phases.get("loop_s", 0.0)
+            for k, v in phases.items():
+                phases_total[k] = phases_total.get(k, 0.0) + v
         wall = time.perf_counter() - t0
         makespan = max((wall - n_fences * rtt) / reps, 1e-9)
+        dispatch_overhead_s = loop_s_total / reps
+        dispatch_phases = {k: v / reps for k, v in phases_total.items()}
 
         peaks: Dict[str, int] = {}
         for d in self.cluster:
@@ -1273,6 +1447,9 @@ class DeviceBackend:
             timings=timings,
             peak_hbm_bytes=peaks,
             n_dispatches=n_disp,
+            dispatch_overhead_s=dispatch_overhead_s,
+            dispatch_phases=dispatch_phases,
+            planned=plan is not None,
             task_outputs=touts if keep_outputs else {},
             streamed=streamer is not None,
             param_loads=streamer.loads if streamer else 0,

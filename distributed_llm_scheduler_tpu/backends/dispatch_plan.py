@@ -1,0 +1,644 @@
+"""Pre-planned per-task dispatch: plan once, launch from a flat table.
+
+The legacy hot loop (``DeviceBackend._run``) re-derives everything per task
+per rep: placement dict lookups, param dict comprehensions, per-argument
+``device_put`` decisions, upstream-failure checks.  On the flagship GPT-2
+DAG that Python bookkeeping is most of the 21.9 ms host dispatch overhead
+(BENCH_r05.json) — work whose inputs (graph, schedule, placed params) are
+all fixed before the first launch.  This module moves it to plan time:
+
+* **Immutable plan** (:class:`DispatchPlan`): built once per ``execute``
+  from the frozen graph, the schedule's dispatch linearization, and the
+  placed params.  Each step carries its resolved jitted executable, a
+  prebuilt param binding dict, and integer indices into a flat value
+  table — the hot loop does list indexing and calls, nothing else.
+* **Batched staging**: all of a step's cross-core inputs go up in ONE
+  ``jax.device_put([...], dev)`` call (the ``_ParamStreamer._load``
+  trick applied to activations).  Transfer edges/bytes are counted
+  statically at plan time with the exact per-(task, arg) semantics of the
+  legacy loop; bytes are filled during the warmup pass and cached.
+* **Donated buffers**: an intermediate output whose globally-last consumer
+  is a same-device step is donated to that step via
+  ``jax.jit(..., donate_argnums=...)``, so XLA reuses the dying buffer for
+  the step's output instead of allocating.  Safety rules (enforced at
+  plan time, assertable from the plan): never donate external
+  (``ext_outputs``) values or the staged graph input — on-device
+  ``device_put`` can return the caller's own array, so deleting it would
+  reach outside the run; never donate the final output or a value any
+  later step still reads; never donate under ``keep_outputs``; a buffer
+  feeding one step at two argument positions is not donated at all.
+  Cross-core transfers are fresh copies owned by the consuming step, so
+  those are always donated (the producer's original stays live).
+* **Coalesced launches** (opt-in ``coalesce=True``): the global dispatch
+  order is first re-linearized to maximize runs of consecutive same-device
+  tasks — legal because async dispatch only needs a task's upstreams
+  *enqueued* first, and both ``Schedule.per_node`` order and topological
+  dispatch order are preserved exactly.  Each run (capped at
+  :data:`_GROUP_CAP` members to bound XLA program size) becomes ONE jitted
+  multi-task call: members read in-group values directly and everything
+  else (earlier task outputs, ext values, the staged graph input) as
+  launch arguments, so per-task placement semantics survive intact.
+  ``jax.lax.optimization_barrier`` between member computations keeps each
+  task's numerics bit-identical to separate launches (XLA cannot fuse
+  across the barrier).  Opt-in because host-side effects inside task fns
+  (``jax.debug.callback(ordered=False)``) have no ordering guarantee
+  within one XLA program.
+
+Fail-and-continue is preserved statically: tasks with failed (unplaced or
+transitively skipped) upstreams are dropped at plan build, mirroring the
+legacy loop's per-task check.  The end-of-run fence reads each device's
+last planned output, exactly like the legacy paths.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import SingleDeviceSharding
+
+try:
+    # fast transfer path: with the target sharding and source avals known
+    # at plan time, calling the runtime's batched_device_put directly skips
+    # ~30 us/array of argument normalization inside public
+    # ``jax.device_put`` (sharding inference, pytree flatten, aval
+    # abstraction).  Semantics match the public path for the cross-device
+    # moves the plan issues (the public path's same-device aliasing
+    # shortcut never applies to them).
+    from jax._src.lib import xla_client as _xc
+
+    def _fast_put(aval, sharding, xs, devices):
+        return _xc.batched_device_put(aval, sharding, xs, devices, True)
+except Exception:  # pragma: no cover - private API moved; use public path
+    _fast_put = None
+
+from .rebatch import extract_steps
+
+
+def _array_bytes(x: Any) -> int:
+    from .device import _array_bytes as f
+
+    return f(x)
+
+
+def _tuple_getter(slots: Sequence[int]):
+    """C-speed multi-index gather over the value table (always a tuple,
+    unlike bare ``itemgetter`` which unwraps a single index)."""
+    from operator import itemgetter
+
+    if not slots:
+        return lambda vals: ()
+    if len(slots) == 1:
+        s = slots[0]
+        return lambda vals: (vals[s],)
+    return itemgetter(*slots)
+
+
+_DONATION_OK: Optional[bool] = None
+
+
+def donation_supported() -> bool:
+    """Probe (once per process) whether this platform honors buffer
+    donation: a donated input must actually be deleted.  Platforms that
+    ignore ``donate_argnums`` (with a warning) get the undonated path."""
+    global _DONATION_OK
+    if _DONATION_OK is None:
+        import warnings
+
+        import numpy as np
+
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                x = jax.device_put(np.ones((4,), np.float32))
+                f = jax.jit(lambda v: v + 1.0, donate_argnums=(0,))
+                jax.block_until_ready(f(x))
+                _DONATION_OK = bool(x.is_deleted())
+        except Exception:
+            _DONATION_OK = False
+    return _DONATION_OK
+
+
+# sentinel naming a root member's graph-input read in a launch's external
+# argument list (the staged per-node input slot backs it at run time)
+GRAPH_INPUT = "__graph_input__"
+
+# max members per coalesced launch — bounds XLA program size / compile time
+_GROUP_CAP = 16
+
+
+def group_arg_binds(graph, tids: Tuple[str, ...]):
+    """Argument wiring for a (possibly coalesced) launch over ``tids``.
+
+    Returns ``(binds, ext_list)``.  ``ext_list`` is the ordered tuple of
+    external inputs the launch takes after the params dict: task ids
+    produced outside the group, or :data:`GRAPH_INPUT` for a root member's
+    graph-input read — one entry per (member, arg position) occurrence,
+    duplicates kept, mirroring the legacy loop's per-argument semantics.
+    ``binds[i]`` wires member i's arguments: ``('v', tid)`` reads an
+    in-group value, ``('x', k)`` reads ``ext_list[k]``.
+    """
+    inside: set = set()
+    binds: List[Tuple[Tuple[str, Any], ...]] = []
+    ext_list: List[str] = []
+    for tid in tids:
+        aids = graph[tid].arg_tasks or graph[tid].dependencies
+        row: List[Tuple[str, Any]] = []
+        if aids:
+            for d in aids:
+                if d in inside:
+                    row.append(("v", d))
+                else:
+                    row.append(("x", len(ext_list)))
+                    ext_list.append(d)
+        else:
+            row.append(("x", len(ext_list)))
+            ext_list.append(GRAPH_INPUT)
+        binds.append(tuple(row))
+        inside.add(tid)
+    return tuple(binds), tuple(ext_list)
+
+
+def _build_group_fn(graph, tids: Tuple[str, ...], exports: Tuple[str, ...]):
+    """One callable running ``tids`` in order: (params-by-global-name,
+    *external-args) -> tuple of export outputs.
+
+    Members read values produced inside the group directly and everything
+    else from the external argument list (wiring from
+    :func:`group_arg_binds`).  ``optimization_barrier`` between members
+    pins each task's computation as its own fusion island, so per-task
+    outputs are bit-identical to separate launches.
+    """
+    steps = extract_steps(graph, tids)
+    binds, _ext = group_arg_binds(graph, tids)
+
+    def group_fn(gp, *ext_args):
+        vals: Dict[str, Any] = {}
+        for i, (tid, fn, pitems, _aids) in enumerate(steps):
+            pd = {loc: gp[g] for loc, g in pitems}
+            args = [
+                vals[ref] if kind == "v" else ext_args[ref]
+                for kind, ref in binds[i]
+            ]
+            out = fn(pd, *args)
+            if i < len(steps) - 1:
+                out = jax.lax.optimization_barrier(out)
+            vals[tid] = out
+        return tuple(vals[t] for t in exports)
+
+    return group_fn
+
+
+def _relinearize(graph, schedule, alive: List[str], done: set) -> List[str]:
+    """Reorder ``alive`` to maximize consecutive same-device runs.
+
+    Legal because async dispatch only requires a task's upstreams to be
+    *enqueued* (not completed) first: the result preserves each node's
+    ``Schedule.per_node`` order exactly (tasks only ever leave the front
+    of their node's queue) and is a topological order of the alive
+    subgraph.  Greedy: stay on the current node while its next task has
+    all upstreams already dispatched; when it blocks, switch to the node
+    with the longest immediately-dispatchable prefix (longer runs mean
+    fewer launches, and more distance between a producer's launch and its
+    consumers' transfers).  A switch target always exists: the earliest
+    not-yet-dispatched task of the original order is always its node's
+    head with every upstream already dispatched."""
+    placement = schedule.placement
+    from collections import deque
+    from itertools import islice
+
+    queues: Dict[str, Any] = {}
+    for t in alive:
+        queues.setdefault(placement[t], deque()).append(t)
+    node_order = sorted(queues)
+    done = set(done)
+    out: List[str] = []
+    cur: Optional[str] = None
+
+    def ready(t: str) -> bool:
+        aids = graph[t].arg_tasks or graph[t].dependencies
+        return all(d in done for d in aids)
+
+    def ready_prefix(q) -> int:
+        n = 0
+        local: set = set()
+        for t in islice(q, _GROUP_CAP):
+            aids = graph[t].arg_tasks or graph[t].dependencies
+            if all(d in done or d in local for d in aids):
+                local.add(t)
+                n += 1
+            else:
+                break
+        return n
+
+    while len(out) < len(alive):
+        q = queues.get(cur)
+        if q and ready(q[0]):
+            t = q.popleft()
+        else:
+            best_n, best_len = None, 0
+            for n in node_order:
+                qn = queues[n]
+                if not qn or not ready(qn[0]):
+                    continue
+                ln = ready_prefix(qn)
+                if ln > best_len:
+                    best_n, best_len = n, ln
+                    if ln >= _GROUP_CAP:
+                        break
+            if best_n is None:  # impossible per the invariant above
+                raise RuntimeError("relinearize: no dispatchable node head")
+            cur = best_n
+            t = queues[cur].popleft()
+        out.append(t)
+        done.add(t)
+    return out
+
+
+class PlanStep:
+    """One launch: a single task or a coalesced same-device group."""
+
+    __slots__ = (
+        "tids",          # task ids in this launch (len 1 unless coalesced)
+        "node_id",
+        "dev",           # jax device the launch runs on
+        "fn",            # resolved jitted callable (donating variant baked in)
+        "pd",            # prebuilt param binding dict (immutable across runs)
+        "arg_slots",     # value-table indices of the launch args, in order
+        "get_args",      # itemgetter over arg_slots (C-speed gather)
+        "xfer_slots",    # unique slots needing device_put onto `dev`
+        "get_srcs",      # itemgetter over xfer_slots
+        "xfer_map",      # (arg position, index into xfer_slots) pairs
+        "xfer_shard",    # SingleDeviceSharding(dev) for the fast put path
+        "xfer_devs",     # [dev] for the fast put path
+        "xfer_avals",    # per-xfer_slots avals, filled on first run;
+                         # False => pytree payloads, public path only
+        "n_edges",       # transfer edges this launch contributes (static)
+        "xfer_bytes",    # per-run transferred bytes; filled on first run
+        "donate_slots",  # slots whose ORIGINAL buffer this launch consumes
+        "donate_argnums",  # jit donate positions (params dict is argument 0)
+        "out_slots",     # value-table indices written (exports, in order)
+        "group",         # True => fn returns a tuple aligned with out_slots
+    )
+
+
+class DispatchPlan:
+    """Immutable dispatch program for one (graph, schedule, ext) triple.
+
+    Built by :meth:`build`; executed by :meth:`run`.  The value table is a
+    flat list: slots 0..len(ext)-1 hold external outputs, then one slot per
+    device that roots read the graph input from, then one slot per exported
+    task output.
+    """
+
+    def __init__(
+        self,
+        backend,
+        steps: List[PlanStep],
+        n_slots: int,
+        ext_slots: Tuple[Tuple[str, int], ...],
+        input_slots: Tuple[Tuple[str, Any, int], ...],
+        fence_slots: Tuple[Tuple[str, int], ...],
+        final_slot: Optional[int],
+        keep_list: Tuple[Tuple[str, int], ...],
+        transfer_edges: int,
+        donate: bool,
+        coalesce: bool,
+    ):
+        self._backend = backend
+        self.steps = steps
+        self.n_slots = n_slots
+        self.ext_slots = ext_slots
+        self.input_slots = input_slots       # (node_id, jax device, slot)
+        self.fence_slots = fence_slots       # (node_id, slot)
+        self.final_slot = final_slot
+        self.keep_list = keep_list           # (tid, slot) when keep_outputs
+        self.transfer_edges = transfer_edges
+        self.donate = donate
+        self.coalesce = coalesce
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def build(
+        cls,
+        backend,
+        graph,
+        schedule,
+        order: Sequence[str],
+        placed_params: Dict[Tuple[str, str], Any],
+        ext_keys: Tuple[str, ...] = (),
+        donate: bool = False,
+        coalesce: bool = False,
+        keep_outputs: bool = False,
+    ) -> "DispatchPlan":
+        placement = schedule.placement
+        if keep_outputs:
+            donate = False  # retained outputs must all outlive the run
+
+        # static fail-and-continue: identical filter to the legacy loop's
+        # per-task upstream check (ext values count as live producers)
+        live: set = set(ext_keys)
+        alive: List[str] = []
+        for tid in order:
+            aids = graph[tid].arg_tasks or graph[tid].dependencies
+            if aids and any(d not in live for d in aids):
+                continue
+            live.add(tid)
+            alive.append(tid)
+
+        # launch groups: singletons unless coalescing is on.  Coalescing
+        # first re-linearizes the dispatch order (per-node order and topo
+        # dispatch preserved), then cuts it into capped same-device runs.
+        groups: List[List[str]] = []
+        if coalesce and alive:
+            alive = _relinearize(graph, schedule, alive, set(ext_keys))
+        if coalesce:
+            for tid in alive:
+                if (
+                    groups
+                    and placement[groups[-1][0]] == placement[tid]
+                    and len(groups[-1]) < _GROUP_CAP
+                ):
+                    groups[-1].append(tid)
+                else:
+                    groups.append([tid])
+        else:
+            groups = [[t] for t in alive]
+
+        group_of = {t: gi for gi, g in enumerate(groups) for t in g}
+        consumers: Dict[str, set] = {t: set() for t in alive}
+        for tid in alive:
+            for d in graph[tid].arg_tasks or graph[tid].dependencies:
+                if d in consumers:
+                    consumers[d].add(group_of[tid])
+        exports_of: List[Tuple[str, ...]] = []
+        for gi, g in enumerate(groups):
+            exports_of.append(tuple(
+                t for t in g
+                if keep_outputs or (consumers[t] - {gi}) or not consumers[t]
+            ))
+
+        # slot allocation: ext, then per-device graph input, then exports
+        slot_of: Dict[str, int] = {}
+        for k in ext_keys:
+            slot_of[k] = len(slot_of)
+        ext_slots = tuple((k, slot_of[k]) for k in ext_keys)
+        input_slot: Dict[str, int] = {}
+        n_slots = len(slot_of)
+        for tid in alive:
+            if not (graph[tid].arg_tasks or graph[tid].dependencies):
+                node = placement[tid]
+                if node not in input_slot:
+                    input_slot[node] = n_slots
+                    n_slots += 1
+        for exports in exports_of:
+            for t in exports:
+                slot_of[t] = n_slots
+                n_slots += 1
+
+        final_tid = graph.topo_order[-1] if graph.topo_order else None
+        final_slot = slot_of.get(final_tid) if final_tid else None
+        fence: Dict[str, int] = {}
+        for gi, g in enumerate(groups):
+            # a group's last member always has outside-or-no consumers,
+            # so it is exported and the fence can read it
+            fence[placement[g[0]]] = slot_of[g[-1]]
+        fence_slots = tuple(sorted(fence.items()))
+
+        # per-group external argument lists (slot-backed launch inputs)
+        ext_lists = [group_arg_binds(graph, tuple(g))[1] for g in groups]
+
+        # last consuming group index per slot (donation lifetime analysis)
+        last_use: Dict[int, int] = {}
+        for gi, ext_list in enumerate(ext_lists):
+            for d in ext_list:
+                if d != GRAPH_INPUT:
+                    last_use[slot_of[d]] = gi
+
+        task_out_slots = set(
+            slot_of[t] for exports in exports_of for t in exports
+        )
+        protected = {final_slot} | {s for _, s in fence_slots}
+
+        steps: List[PlanStep] = []
+        transfer_edges = 0
+        for gi, g in enumerate(groups):
+            lead = graph[g[0]]
+            node = placement[g[0]]
+            dev = backend.cluster[node].jax_device
+            ext_list = ext_lists[gi]
+            arg_slots = tuple(
+                input_slot[node] if d == GRAPH_INPUT else slot_of[d]
+                for d in ext_list
+            )
+
+            xfer_slots: List[int] = []
+            xfer_map: List[Tuple[int, int]] = []
+            xfer_ext: set = set()  # xfer indices sourced from ext values
+            for pos, d in enumerate(ext_list):
+                if d == GRAPH_INPUT or placement.get(d) == node:
+                    # graph input is pre-staged per node; same-core edges
+                    # need no transfer (legacy parity)
+                    continue
+                s = slot_of[d]
+                if s in xfer_slots:
+                    ui = xfer_slots.index(s)
+                else:
+                    ui = len(xfer_slots)
+                    xfer_slots.append(s)
+                xfer_map.append((pos, ui))
+                if d not in placement:
+                    xfer_ext.add(ui)
+                transfer_edges += 1
+
+            donate_pos: List[int] = []
+            donate_slots: List[int] = []
+            if donate:
+                pos_of_slot: Dict[int, List[int]] = {}
+                for pos, s in enumerate(arg_slots):
+                    pos_of_slot.setdefault(s, []).append(pos)
+                moved = {pos for pos, _ in xfer_map}
+                for s, poss in pos_of_slot.items():
+                    if len(poss) != 1:
+                        continue  # one buffer at two positions: never donate
+                    pos = poss[0]
+                    if pos in moved:
+                        # the device_put copy is owned by this launch; ext
+                        # values are excluded (on-device device_put can
+                        # alias the caller's array)
+                        ui = next(
+                            u for p, u in xfer_map if p == pos
+                        )
+                        if ui not in xfer_ext:
+                            donate_pos.append(pos)
+                    elif (
+                        s in task_out_slots
+                        and last_use.get(s) == gi
+                        and s not in protected
+                    ):
+                        donate_pos.append(pos)
+                        donate_slots.append(s)
+            donate_argnums = tuple(1 + p for p in sorted(donate_pos))
+
+            step = PlanStep()
+            step.tids = tuple(g)
+            step.node_id = node
+            step.dev = dev
+            step.arg_slots = arg_slots
+            step.get_args = _tuple_getter(arg_slots)
+            step.xfer_slots = tuple(xfer_slots)
+            step.get_srcs = _tuple_getter(step.xfer_slots)
+            step.xfer_map = tuple(xfer_map)
+            step.xfer_shard = SingleDeviceSharding(dev) if xfer_slots else None
+            step.xfer_devs = [dev]
+            step.xfer_avals = None
+            step.n_edges = len(xfer_map)
+            step.xfer_bytes = None if xfer_map else 0
+            step.donate_slots = tuple(donate_slots)
+            step.donate_argnums = donate_argnums
+            step.group = len(g) > 1
+            if step.group:
+                exports = exports_of[gi]
+                step.out_slots = tuple(slot_of[t] for t in exports)
+                step.fn = backend._grouped_jitted(
+                    graph, tuple(g), exports, donate_argnums
+                )
+                step.pd = {
+                    glob: placed_params[(glob, node)]
+                    for t in g
+                    for _, glob in graph[t].param_items()
+                }
+            else:
+                step.out_slots = (slot_of[g[0]],)
+                step.fn = backend._jitted(graph, g[0], donate_argnums)
+                step.pd = {
+                    loc: placed_params[(glob, node)]
+                    for loc, glob in lead.param_items()
+                }
+            steps.append(step)
+
+        keep_list = tuple(
+            (t, slot_of[t]) for exports in exports_of for t in exports
+        ) if keep_outputs else ()
+        return cls(
+            backend, steps, n_slots, ext_slots,
+            tuple(
+                (n, backend.cluster[n].jax_device, s)
+                for n, s in sorted(input_slot.items())
+            ),
+            fence_slots, final_slot, keep_list, transfer_edges,
+            donate, coalesce,
+        )
+
+    # -- identity ----------------------------------------------------------
+    def signature(self) -> Tuple:
+        """Hashable structural identity: two builds over the same
+        (graph, schedule, ext keys, flags) must compare equal.  Contains
+        no object identities, only names and slot indices."""
+        return (
+            self.n_slots,
+            self.ext_slots,
+            tuple((n, s) for n, _d, s in self.input_slots),
+            self.fence_slots,
+            self.final_slot,
+            self.transfer_edges,
+            self.donate,
+            self.coalesce,
+            tuple(
+                (
+                    st.tids, st.node_id, st.arg_slots, st.xfer_slots,
+                    st.xfer_map, st.donate_slots, st.donate_argnums,
+                    st.out_slots,
+                )
+                for st in self.steps
+            ),
+        )
+
+    @property
+    def n_launches(self) -> int:
+        return len(self.steps)
+
+    # -- execution ---------------------------------------------------------
+    def run(
+        self,
+        graph_input: Any,
+        ext_outputs: Optional[Dict[str, Any]] = None,
+        fence: bool = True,
+    ) -> Tuple[Any, Dict, int, int, int, int, Dict[str, Any], Dict[str, float]]:
+        """Execute the plan once.  Same return contract as the legacy
+        runners plus a phase dict: ``(final, timings, transfer_edges,
+        transfer_bytes, n_fences, n_dispatches, executed, phases)`` with
+        ``phases = {loop_s, stage_s, launch_s}`` — host wall inside the
+        dispatch loop (fence excluded), split into staging (input placement
+        + batched transfers) and launch (executable calls)."""
+        vals: List[Any] = [None] * self.n_slots
+        t_loop0 = time.perf_counter()
+        stage_s = 0.0
+        if ext_outputs:
+            for k, s in self.ext_slots:
+                vals[s] = ext_outputs[k]
+        if self.input_slots:
+            t0 = time.perf_counter()
+            for _n, dev, s in self.input_slots:
+                vals[s] = jax.device_put(graph_input, dev)
+            stage_s += time.perf_counter() - t0
+
+        tbytes = 0
+        for step in self.steps:
+            if step.xfer_slots:
+                args = list(step.get_args(vals))
+                srcs = step.get_srcs(vals)
+                if step.xfer_bytes is None:
+                    step.xfer_bytes = sum(
+                        _array_bytes(srcs[ui]) for _p, ui in step.xfer_map
+                    )
+                t0 = time.perf_counter()
+                if step.xfer_avals and _fast_put is not None:
+                    shard, devs = step.xfer_shard, step.xfer_devs
+                    moved = [
+                        _fast_put(av, shard, [x], devs)
+                        for av, x in zip(step.xfer_avals, srcs)
+                    ]
+                else:
+                    # first (warmup) pass: public path, then cache avals.
+                    # Pytree task outputs (dict-of-grads, cache slabs)
+                    # have no single aval — those steps stay on the
+                    # public path permanently (False sentinel).
+                    moved = jax.device_put(srcs, step.dev)
+                    if step.xfer_avals is None:
+                        step.xfer_avals = (
+                            tuple(m.aval for m in moved)
+                            if all(hasattr(m, "aval") for m in moved)
+                            else False
+                        )
+                stage_s += time.perf_counter() - t0
+                for pos, ui in step.xfer_map:
+                    args[pos] = moved[ui]
+            else:
+                args = step.get_args(vals)
+            tbytes += step.xfer_bytes
+            if step.group:
+                outs = step.fn(step.pd, *args)
+                for s, o in zip(step.out_slots, outs):
+                    vals[s] = o
+            else:
+                vals[step.out_slots[0]] = step.fn(step.pd, *args)
+        loop_s = time.perf_counter() - t_loop0
+
+        n_fences = 0
+        if fence and self.steps:
+            n_fences = self._backend._fence_run(
+                {n: vals[s] for n, s in self.fence_slots}
+            )
+        final = vals[self.final_slot] if self.final_slot is not None else None
+        executed = {t: vals[s] for t, s in self.keep_list}
+        return (
+            final, {}, self.transfer_edges, tbytes, n_fences,
+            len(self.steps), executed,
+            {
+                "loop_s": loop_s,
+                "stage_s": stage_s,
+                "launch_s": loop_s - stage_s,
+            },
+        )

@@ -33,6 +33,28 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..core.graph import TaskGraph, is_batch0, rootslice_of
 
 
+def extract_steps(
+    graph: TaskGraph, tids: Sequence[str]
+) -> Tuple[Tuple[str, Any, Tuple[Tuple[str, str], ...], Tuple[str, ...]], ...]:
+    """Per-task ``(tid, fn, param_items, arg_ids)`` extracted up front.
+
+    Shared by every multi-task callable builder (segment fusion here and in
+    ``DeviceBackend._segment_callable``, coalesced launch groups in
+    :mod:`.dispatch_plan`): closures built over these tuples never capture
+    ``graph``, so a cache value keyed weakly by the graph cannot keep its
+    own key alive.
+    """
+    return tuple(
+        (
+            tid,
+            graph[tid].fn,
+            tuple(graph[tid].param_items()),
+            tuple(graph[tid].arg_tasks or graph[tid].dependencies),
+        )
+        for tid in tids
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class RebatchPlan:
     """Static execution plan for one segment.
@@ -353,12 +375,8 @@ def build_rebatched_seg_fn(
 
     # precompute per-task static info (the closure must not hold `graph`)
     step_info = {
-        t: (
-            graph[t].fn,
-            tuple(graph[t].param_items()),
-            tuple(graph[t].arg_tasks or graph[t].dependencies),
-        )
-        for t in tids
+        t: (fn, pitems, aids)
+        for t, fn, pitems, aids in extract_steps(graph, tids)
     }
     class_of: Dict[str, Tuple[int, int]] = {}
     offsets: List[List[int]] = []

@@ -62,6 +62,20 @@ class Schedule:
                 out[tid] = node_id
         return out
 
+    def signature(self) -> tuple:
+        """Hashable identity of the scheduling DECISION: policy, per-node
+        ordered task lists, and global assignment order — everything a
+        dispatch plan is a pure function of.  Two schedules with equal
+        signatures must produce identical dispatch plans
+        (:mod:`..backends.dispatch_plan`); mutable backend-filled state
+        (timings) and bookkeeping (completed/failed, wall time) are
+        deliberately excluded."""
+        return (
+            self.policy,
+            tuple((n, tuple(ts)) for n, ts in sorted(self.per_node.items())),
+            tuple(self.assignment_order),
+        )
+
     def completion_rate(self, total_tasks: int) -> float:
         return len(self.completed) / total_tasks if total_tasks else 0.0
 

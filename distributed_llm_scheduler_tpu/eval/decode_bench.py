@@ -244,8 +244,8 @@ def measure_decode(
             # sequence agreement is seed-chaotic (one early flip re-seeds
             # everything after it), and it's the figure the quantization
             # scheme actually moves: per-channel 7.6% flip / grouped+
-            # row-emb 5.9% on the gpt2-small config (fidelity sweep,
-            # DECODE_r05).
+            # row-emb 5.9% on the gpt2-small config (fidelity sweep;
+            # artifact pending recapture).
             from ..utils.quantize import dequantize as _deq
 
             out["quant_scheme"] = "grouped64+rowwise_embed"

@@ -72,15 +72,20 @@ def cross_group_agreement(
 ) -> Optional[float]:
     """Fraction of cross-group pairs whose measured order matches the
     predicted group order (1.0 = every pair the sim actually claimed an
-    order for came out that way).  None when every item shares one group
-    (no falsifiable cross-group claim)."""
-    ok = tot = 0
+    order for came out that way).  An exact measured tie carries no
+    order information either way, so it scores 0.5 rather than counting
+    as a full agreement.  None when every item shares one group (no
+    falsifiable cross-group claim)."""
+    ok = 0.0
+    tot = 0
     for gi in range(len(groups)):
         for gj in range(gi + 1, len(groups)):
             for a in groups[gi]:
                 for b in groups[gj]:
                     tot += 1
-                    if measured[a] <= measured[b]:
+                    if measured[a] == measured[b]:
+                        ok += 0.5
+                    elif measured[a] < measured[b]:
                         ok += 1
     return ok / tot if tot else None
 

@@ -195,7 +195,8 @@ def _decode_attention_natural(
     Computing scores as ``K @ q`` instead ((B, Hkv, M, G) with M on
     sublanes, exactly the cache's storage layout) runs the identical
     math at 576 GB/s (0.81 -> 0.29 ms/step on the 12-layer flagship
-    attribution; DECODE_r05).  A Pallas per-layer kernel was tried first
+    attribution; artifact pending recapture).  A Pallas per-layer kernel
+    was tried first
     and LOST: ~66 us fixed cost per pallas_call x 12 sequential layers
     swamps any in-kernel win — the right decode kernel here is the one
     XLA already has, fed shapes in its preferred orientation.

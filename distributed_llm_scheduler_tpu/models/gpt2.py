@@ -186,9 +186,15 @@ def output_projection(x: jax.Array, wte: jax.Array) -> jax.Array:
     operands contract their LAST axis (lanes), no transpose
     materialized — and only the tiny (V, rows) result transposes.  Row
     threshold 64: past that the matmul is MXU-compute-bound and the big
-    output transpose would cost more than it saves."""
-    B, T, D = x.shape
-    if B * T <= 64:
+    output transpose would cost more than it saves.
+
+    The fast path only handles the canonical (B, T, D) activations;
+    pre-flattened (rows, D) inputs take the plain tied matmul."""
+    if x.ndim == 3:
+        B, T, D = x.shape
+    else:
+        B = 0  # disable the reshape fast path below
+    if x.ndim == 3 and B * T <= 64:
         flat = x.reshape(B * T, D)
         scores = jax.lax.dot_general(
             wte, flat, (((1,), (1,)), ((), ()))

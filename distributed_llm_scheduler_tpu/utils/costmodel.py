@@ -129,6 +129,15 @@ def time_amortized(call: Any, reps: int, rtt: float) -> float:
     return max(time.perf_counter() - t0 - rtt, 0.0) / reps
 
 
+def repeat_capture(fn: Any, n: int) -> "list[float]":
+    """All ``n`` samples of ``fn()``, in capture order — the raw material
+    every derived estimator (min for device time, median for headline
+    quotes, min/max for the artifact's spread block) reduces from.  One
+    definition so sample collection can't diverge between the calibrator,
+    benchlib's ``best_of``, and bench.py's repeat-capture spread."""
+    return [fn() for _ in range(n)]
+
+
 def _output_capped_reps(out: Any, reps: int, budget_bytes: int = 1 << 30) -> int:
     """Cap in-flight repetitions so queued output buffers stay under
     ``budget_bytes``: async dispatch can run ~reps outputs ahead of

@@ -91,7 +91,8 @@ def quantize_array_rowwise(x: jax.Array) -> QParam:
     so every vocab candidate's logit error is proportional to its own
     row magnitude instead of the column-absmax outlier's.  Measured on
     the gpt2-small decode config this cuts the prefill argmax flip rate
-    from 7.6% to 6.7% on its own (DECODE_r05 fidelity sweep)."""
+    from 7.6% to 6.7% on its own (fidelity sweep; artifact pending
+    recapture)."""
     xf = jnp.asarray(x, jnp.float32)
     absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
     scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
@@ -178,7 +179,8 @@ def quantize_params(
     variant: ``rowwise_keys`` entries (embedding tables — see
     :data:`ROWWISE_EMBED_KEYS`) get per-row scales, everything else gets
     ``group``-blocked contraction-axis scales.  Fidelity/byte trade-off
-    measured on gpt2-small (DECODE_r05): argmax flip rate 7.6% → 5.9%,
+    measured on gpt2-small (artifact pending recapture): argmax flip
+    rate 7.6% → 5.9%,
     logit RMSE −18%, for +6.25% scale bytes on matrices at group=64."""
     if scheme == "channel":
         return {

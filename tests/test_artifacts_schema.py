@@ -100,6 +100,27 @@ def test_train_artifact_schema():
         assert "makespan_ms" in row and "completion" in row, (path, name)
 
 
+def test_bench_artifact_spread_schema():
+    """Repeat-capture honesty: once a BENCH artifact carries a ``spread``
+    block (added r6), every leg must hold median/min/max over N>=3
+    windows with the headline estimator declared.  Older artifacts
+    predate the block and are exempt (values re-captured per round)."""
+    d, path = _latest("BENCH")
+    if "spread" not in d:
+        pytest.skip(f"{path} predates the spread block")
+    sp = d["spread"]
+    assert sp.get("quotes") == "median", path
+    legs = {k: v for k, v in sp.items() if k != "quotes"}
+    assert legs, f"{path}: spread block has no measured legs"
+    for leg, st in legs.items():
+        for k in ("median_ms", "min_ms", "max_ms", "n"):
+            assert k in st, (path, leg, k)
+        assert st["n"] >= 3, (path, leg)
+        assert st["min_ms"] <= st["median_ms"] <= st["max_ms"], (path, leg)
+    if "dispatch_overhead_ms" in d:
+        assert d["dispatch_overhead_ms"] >= 0, path
+
+
 def test_bench_medium_artifact_schema():
     d, path = _latest("BENCH_MEDIUM")
     for k in ("metric", "value", "unit", "vs_baseline", "fallback"):
