@@ -180,7 +180,15 @@ def cmd_lint(args) -> int:
         print("--decode needs a real model family (gpt2*/llama*/mixtral*)",
               file=sys.stderr)
         return 2
-    if args.decode:
+    if args.paged and _weights_family(cfg.model) != "gpt2":
+        print("--paged lints the paged decode step (gpt2 family only)",
+              file=sys.stderr)
+        return 2
+    if args.paged:
+        from .frontend.decode_dag import build_paged_decode_dag
+
+        dag = build_paged_decode_dag(cfg.model_config(), slots=cfg.batch)
+    elif args.decode:
         from .frontend.decode_dag import build_decode_dag_any
 
         dag = build_decode_dag_any(cfg.model_config(), batch=cfg.batch)
@@ -191,6 +199,14 @@ def cmd_lint(args) -> int:
     else:
         dag = cfg.build_graph()
     graph = getattr(dag, "graph", dag)
+    if args.fix:
+        from .analysis import fix_duplicate_dependencies
+
+        fixed = fix_duplicate_dependencies(graph)
+        if fixed:
+            shown = ", ".join(fixed[:5]) + ("..." if len(fixed) > 5 else "")
+            print(f"--fix: deduplicated dependencies on {len(fixed)} "
+                  f"task(s): {shown}", file=sys.stderr)
     cluster = cfg.build_cluster()
     schedule = cfg.build_scheduler().schedule(graph, cluster)
 
@@ -1048,6 +1064,13 @@ def main(argv=None) -> int:
     p.add_argument("--decode", action="store_true",
                    help="lint the single-token decode-step DAG instead of "
                         "the full forward")
+    p.add_argument("--paged", action="store_true",
+                   help="lint the paged KV-cache decode-step DAG "
+                        "(--batch sets the slot count; gpt2 family only)")
+    p.add_argument("--fix", action="store_true",
+                   help="apply mechanical fixes before linting "
+                        "(DAG003 duplicate-dependency dedup; arg_tasks "
+                        "keeps the original call arity)")
     p.add_argument("--strict", action="store_true",
                    help="treat eviction-required residency (MEM002) as an "
                         "error")

@@ -30,6 +30,8 @@ from .diagnostics import (
     Diagnostic,
     Severity,
 )
+from .decode_pass import analyze_decode
+from .fixes import fix_duplicate_dependencies
 from .graph_pass import analyze_graph
 from .memory_pass import analyze_memory
 from .pipeline_pass import analyze_pipeline
@@ -44,12 +46,14 @@ __all__ = [
     "Diagnostic",
     "Severity",
     "analyze",
+    "analyze_decode",
     "analyze_graph",
     "analyze_memory",
     "analyze_pipeline",
     "analyze_quantization",
     "analyze_schedule",
     "analyze_sharding",
+    "fix_duplicate_dependencies",
     "gate_enabled",
     "pre_execution_gate",
 ]
@@ -83,6 +87,7 @@ def analyze(
     quantization pass runs when ``param_specs`` is given.
     """
     rep = analyze_graph(graph)
+    rep.extend(analyze_decode(graph, cluster, schedule))
     if cluster is not None and schedule is not None:
         rep.extend(analyze_schedule(graph, cluster, schedule))
         rep.extend(analyze_memory(graph, cluster, schedule, strict=strict))
@@ -109,11 +114,11 @@ def analyze(
 # defects that would *corrupt* a replay or dispatch, per backend.
 _GATE_CODES = {
     "sim": frozenset(
-        {"DAG001", "DAG002", "DAG005", "DAG007",
+        {"DAG001", "DAG002", "DAG005", "DAG007", "DEC001", "DEC003",
          "SCH001", "SCH002", "SCH003", "SCH009", "PIP001", "PIP002"}
     ),
     "device": frozenset(
-        {"DAG001", "DAG002", "DAG005", "DAG007",
+        {"DAG001", "DAG002", "DAG005", "DAG007", "DEC001", "DEC003",
          "SCH001", "SCH002", "SCH003"}
     ),
 }
@@ -135,6 +140,7 @@ def pre_execution_gate(
         return None
     codes = _GATE_CODES[backend]
     rep = analyze_graph(graph)
+    rep.extend(analyze_decode(graph, cluster, schedule))
     rep.extend(analyze_schedule(graph, cluster, schedule))
     if backend == "sim":
         rep.extend(analyze_pipeline(graph, schedule))

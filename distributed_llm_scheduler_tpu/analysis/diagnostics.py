@@ -63,6 +63,11 @@ CODES: Dict[str, str] = {
     # -- pipeline soundness (pipeline_pass) -----------------------------
     "PIP001": "per-node order violates same-node stage dependency",
     "PIP002": "cross-node deadlock in per-node execution orders",
+    # -- decode-loop composability (decode_pass) ------------------------
+    "DEC001": "mutable decode cache param aliased across nodes",
+    "DEC002": "decode step spans multiple nodes: scan-loop ineligible",
+    "DEC003": "inconsistent paged KV wiring (pools vs page_table)",
+    "DEC004": "per-step KV-cache residency (informational)",
     # -- quantization dtype flow (quant_pass) ---------------------------
     "QNT001": "QParam with wrong component dtypes",
     "QNT002": "QParam scale shape matches no known layout",

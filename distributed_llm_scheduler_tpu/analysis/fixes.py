@@ -1,0 +1,41 @@
+"""Mechanical fixes for the auto-repairable diagnostics (``lint --fix``).
+
+Only findings whose repair is provably behavior-preserving get a fixer.
+Today that is ``DAG003`` (duplicate dependency): dependency *edges* are a
+set semantically, but ``Task.arg_tasks`` — which defaults to the
+dependency list — is positional, so deduplicating in place would silently
+change a task's call arity.  The fixer therefore pins ``arg_tasks`` to
+the original (duplicated) list before deduplicating ``dependencies``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.graph import TaskGraph
+
+
+def fix_duplicate_dependencies(graph: TaskGraph) -> List[str]:
+    """Deduplicate every task's ``dependencies`` in place, first-occurrence
+    order preserved.  Tasks relying on the arg_tasks-defaults-to-deps
+    behavior keep their fn call arity: the original list is pinned as
+    ``arg_tasks`` before the dedup.  Returns the ids of the tasks fixed.
+    """
+    was_frozen = graph._topo is not None
+    fixed: List[str] = []
+    for t in graph.tasks():
+        if len(t.dependencies) == len(set(t.dependencies)):
+            continue
+        if t.arg_tasks is None:
+            t.arg_tasks = list(t.dependencies)
+        seen = set()
+        deduped = []
+        for d in t.dependencies:
+            if d not in seen:
+                seen.add(d)
+                deduped.append(d)
+        t.dependencies = deduped
+        fixed.append(t.task_id)
+    if fixed and was_frozen:
+        graph.freeze()  # rebuild the cached dependents/topo edge state
+    return fixed

@@ -24,7 +24,7 @@ Reference anchor: the scheduler-owns-inference story is this repo's own
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -150,3 +150,441 @@ def split_cache_params(
     weights = {k: v for k, v in params.items() if not k.startswith("cache_")}
     caches = {k: v for k, v in params.items() if k.startswith("cache_")}
     return weights, caches
+
+
+def _placed_order(graph: TaskGraph, schedule: Schedule) -> list:
+    """Schedule assignment order, single-node-validated and re-linearized
+    topologically (shared by the dense and paged step composers)."""
+    placement = schedule.placement
+    nodes = {placement[tid] for tid in placement}
+    if len(nodes) > 1:
+        raise ValueError(
+            f"decode loop requires a single-node placement, got {len(nodes)} "
+            "nodes — multi-node decode steps go through per-task dispatch "
+            "(DeviceBackend.execute)"
+        )
+    topo_pos = {tid: i for i, tid in enumerate(graph.topo_order)}
+    order = sorted(
+        (tid for tid in schedule.assignment_order if tid in placement),
+        key=topo_pos.__getitem__,
+    )
+    missing = set(graph.task_ids()) - set(order)
+    if missing:
+        raise ValueError(f"placement does not cover tasks {sorted(missing)}")
+    sinks = [tid for tid in order if not graph.dependents(tid)]
+    if len(sinks) != 1:
+        raise ValueError(f"expected one sink (logits) task, got {sinks}")
+    return order
+
+
+def compose_paged_step_fn(
+    graph: TaskGraph,
+    schedule: Schedule,
+    config: Any,
+) -> Callable[..., Tuple[jax.Array, Dict[str, Any]]]:
+    """Compose the placed PAGED decode-step DAG (``build_paged_decode_dag``)
+    into one traced step function.
+
+    Same contract as :func:`compose_step_fn` — tasks run in the
+    schedule's order, placement stays scheduler-owned — but the cache
+    params are shared page pools, positions are the per-slot ``lengths``
+    vector, and the per-layer fold is a page-table-directed scatter
+    (:func:`...models.kv_pages.write_token_kv`) gated by the ``active``
+    mask: inactive slots (retired or not yet admitted) write the trash
+    page, so one compiled step serves every admission/retirement state.
+
+    Returns ``step(weights, pools, page_table, ids, lengths, active)
+    -> (logits, new_pools)``.
+    """
+    from ..models.kv_pages import write_token_kv
+
+    order = _placed_order(graph, schedule)
+    sink = [tid for tid in order if not graph.dependents(tid)][0]
+    n_layers, _, _ = cache_dims(config)
+
+    def step(weights, pools, page_table, ids, lengths, active):
+        inputs = {"ids": ids, "lengths": lengths}
+        outs: Dict[str, Any] = {}
+        for tid in order:
+            task = graph[tid]
+            alias = task.param_alias or {}
+            p = {}
+            for loc, glob in alias.items():
+                if glob == "page_table":
+                    p[loc] = page_table
+                elif glob in pools:
+                    p[loc] = pools[glob]
+                else:
+                    p[loc] = weights[glob]
+            if task.dependencies:
+                args = [outs[d] for d in (task.arg_tasks or task.dependencies)]
+            else:
+                args = [inputs]
+            outs[tid] = task.fn(p, *args)
+        logits = outs[sink]
+        new_pools = dict(pools)
+        for i in range(n_layers):
+            o = outs[f"layer_{i}"]
+            for kind in ("k", "v"):
+                new_pools[f"cache_{kind}_{i}"] = write_token_kv(
+                    new_pools[f"cache_{kind}_{i}"], o[f"{kind}_new"],
+                    page_table, lengths, active,
+                )
+        return logits, new_pools
+
+    return step
+
+
+def build_paged_decode_loop(
+    graph: TaskGraph,
+    schedule: Schedule,
+    config: Any,
+    steps: int,
+    weights: Optional[Dict[str, Any]] = None,
+) -> Callable[..., Tuple[jax.Array, Dict[str, Any]]]:
+    """Jit one K-step greedy segment over the scheduled paged step DAG,
+    page pools donated.
+
+    ``seg(weights, pools, page_table, lengths, cur_tok, remaining) ->
+    (tokens, new_pools)`` where ``cur_tok`` is each slot's (S, 1)
+    current token, ``remaining`` the (S,) int32 decode steps each slot
+    still owes, and ``tokens`` the (S, steps) greedy continuation (rows
+    past a slot's ``remaining`` are garbage — the caller truncates).
+    Slots stay active exactly while ``remaining > 0``: lengths stop
+    advancing and pool writes divert to the trash page the step after a
+    slot finishes, so admission and retirement between segments never
+    recompile — the shapes are the static ``slots`` geometry, only array
+    contents change.
+
+    Pass ``weights`` to BIND them into the compiled program as
+    captured constants: the returned callable drops the leading
+    ``weights`` argument (``seg(pools, page_table, ...)``), and every
+    call skips flattening the weight pytree — measurable per-call
+    overhead at serving segment rates.  The engine always binds; the
+    unbound form exists for callers that swap weights between calls.
+    """
+    step = compose_paged_step_fn(graph, schedule, config)
+
+    def seg(weights, pools, page_table, lengths, cur_tok, remaining):
+        def body(carry, _):
+            pools, lengths, cur_tok, remaining = carry
+            active = remaining > 0
+            logits, pools = step(
+                weights, pools, page_table, cur_tok, lengths, active
+            )
+            nxt = jnp.argmax(
+                logits[:, -1, :], axis=-1
+            ).astype(jnp.int32)[:, None]
+            cur_tok = jnp.where(active[:, None], nxt, cur_tok)
+            lengths = lengths + active.astype(jnp.int32)
+            remaining = jnp.maximum(remaining - 1, 0)
+            return (pools, lengths, cur_tok, remaining), nxt[:, 0]
+
+        (pools2, _, _, _), toks = jax.lax.scan(
+            body, (pools, lengths, cur_tok, remaining), None, length=steps
+        )
+        # slot state is NOT returned: the host reconstructs lengths /
+        # cur_tok / remaining from ``toks`` exactly (they're deterministic
+        # functions of the emitted tokens), saving per-segment readbacks
+        return toks.T, pools2
+
+    if weights is not None:
+        w = weights
+        return jax.jit(
+            lambda pools, page_table, lengths, cur_tok, remaining: seg(
+                w, pools, page_table, lengths, cur_tok, remaining
+            ),
+            donate_argnums=(0,),
+        )
+    return jax.jit(seg, donate_argnums=(1,))
+
+
+class PagedDecodeEngine:
+    """Continuous-batching paged decode: admit and retire variable-length
+    requests between scanned K-step segments.
+
+    The serving loop the dense path cannot run: ``slots`` static batch
+    lanes share one paged KV pool; a host-side :class:`...models.kv_pages.
+    PagePool` free-list hands each admitted request exactly the pages its
+    ``prompt + max_new`` horizon needs (exhaustion leaves requests queued
+    — backpressure, not corruption); retirement returns them.  Between
+    segments the host folds results, frees, and admits; the segment
+    itself is ONE dispatched XLA program (``build_paged_decode_loop``,
+    pools donated), so steady-state decode pays one host round-trip per
+    ``seg_steps`` tokens across ALL active requests — and because slot
+    state is data, not shape, admission never recompiles.
+
+    Placement stays scheduler-owned: the engine composes the placed
+    paged decode-step DAG, exactly like the dense loop.  Construct via
+    ``DeviceBackend.paged_decode_engine`` to run the pre-execution
+    analysis gate first.
+    """
+
+    def __init__(
+        self,
+        graph: TaskGraph,
+        schedule: Schedule,
+        config: Any,
+        weights: Dict[str, Any],
+        pool: Any,
+        slots: int,
+        pages_per_seq: int,
+        seg_steps: int = 8,
+    ):
+        import numpy as np
+
+        from ..frontend.decode_dag import cache_dims as _cd
+        from ..models.kv_pages import TRASH_PAGE, init_paged_kv
+
+        self.config = config
+        self.weights = weights
+        self.pool = pool
+        self.slots = slots
+        self.pages_per_seq = pages_per_seq
+        self.page_size = pool.page_size
+        self.capacity = pages_per_seq * pool.page_size
+        self.seg_steps = seg_steps
+        self._np = np
+        n_layers, n_kv, hd = _cd(config)
+        self.n_layers = n_layers
+        self._seg = build_paged_decode_loop(
+            graph, schedule, config, seg_steps, weights=weights
+        )
+        # device state: ONLY the pools live on device (donated through
+        # every call); slot bookkeeping stays host-side numpy — lengths /
+        # cur_tok / remaining are deterministic functions of the emitted
+        # tokens, so keeping them on host avoids a flurry of tiny .at[]
+        # dispatches per admission and per-segment readbacks (at serving
+        # granularity that overhead was the whole paged-vs-dense margin)
+        self.pools = init_paged_kv(
+            n_layers, pool.n_pages, pool.page_size, n_kv, hd, config.dtype
+        )
+        self.page_table = np.full(
+            (slots, pages_per_seq), TRASH_PAGE, np.int32
+        )
+        self.lengths = np.zeros((slots,), np.int32)
+        self.cur_tok = np.zeros((slots, 1), np.int32)
+        self.remaining = np.zeros((slots,), np.int32)
+        # host state
+        self._queue: list = []
+        self._slot_req: list = [None] * slots   # request id per busy slot
+        self._slot_pages: list = [[] for _ in range(slots)]
+        self._tokens: Dict[Any, list] = {}
+        self.results: Dict[Any, Any] = {}
+        self._prefill_cache: Dict[int, Any] = {}
+        self.segments_run = 0
+
+    def reset(self) -> None:
+        """Fresh pool/table/queue state, compiled programs kept.
+
+        The segment, prefill, and scatter executables are keyed to this
+        instance, so benchmarks warm up once, reset, and re-time the
+        exact workload without paying compilation again."""
+        from ..models.kv_pages import TRASH_PAGE, init_paged_kv
+
+        np = self._np
+        for pages in self._slot_pages:
+            if pages:
+                self.pool.free(pages)
+        n_layers = self.n_layers
+        n_kv, hd = self.pools["cache_k_0"].shape[2:]
+        self.pools = init_paged_kv(
+            n_layers, self.pool.n_pages, self.pool.page_size, n_kv, hd,
+            self.config.dtype,
+        )
+        self.page_table = np.full(
+            (self.slots, self.pages_per_seq), TRASH_PAGE, np.int32
+        )
+        self.lengths = np.zeros((self.slots,), np.int32)
+        self.cur_tok = np.zeros((self.slots, 1), np.int32)
+        self.remaining = np.zeros((self.slots,), np.int32)
+        self._queue = []
+        self._slot_req = [None] * self.slots
+        self._slot_pages = [[] for _ in range(self.slots)]
+        self._tokens = {}
+        self.results = {}
+        self.segments_run = 0
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, rid: Any, prompt_ids: Any, max_new_tokens: int) -> None:
+        """Queue a request; admitted into a free slot (and its pages
+        allocated) at the next segment boundary."""
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
+            raise ValueError("prompt_ids must be (1, prompt_len)")
+        total = prompt_ids.shape[1] + max_new_tokens
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if total > self.capacity:
+            raise ValueError(
+                f"request needs {total} rows > per-slot capacity "
+                f"{self.capacity} ({self.pages_per_seq} pages x "
+                f"{self.page_size})"
+            )
+        self._queue.append((rid, prompt_ids, max_new_tokens))
+
+    # -- prefill + page scatter (ONE call per admission ROUND; one
+    # compiled class per (prompt length, batch size)) ----------------------
+    def _prefill_scatter(self, prompt_ids: jax.Array, pt_rows):
+        """Prefill ``b`` same-length prompts and scatter all their cache
+        rows into their pages in ONE jitted, pool-donating call.
+
+        ``prompt_ids`` (b, P); ``pt_rows`` (b, pages_per_seq) physical
+        page rows (trash-padded tails).  Returns the (b,) first greedy
+        tokens.  Weights are bound constants (see the segment fn)."""
+        from ..frontend.decode_dag import cache_dims as _cd
+        from ..models import decode as _decode
+        from ..parallel.decode import _family_of, _module_for
+
+        b, P = prompt_ids.shape
+        fn = self._prefill_cache.get((P, b))
+        if fn is None:
+            mod = _module_for(_family_of(self.config))
+            n_layers, n_kv, hd = _cd(self.config)
+            cap, cfg = self.capacity, self.config
+            ppseq, ps = self.pages_per_seq, self.page_size
+
+            w = self.weights  # bound constants, same as the segment fn
+
+            def _fn(ids, pools, pages):
+                cache = _decode.init_cache(
+                    n_layers, b, n_kv, cap, hd, cfg.dtype
+                )
+                logits, cache = mod.forward_cached(
+                    w, ids, cache, 0, cfg
+                )
+                first = jnp.argmax(
+                    logits[:, -1, :], axis=-1
+                ).astype(jnp.int32)
+                flat_pages = pages.reshape(b * ppseq)
+                new = dict(pools)
+                for i in range(n_layers):
+                    for kind in ("k", "v"):
+                        # (b, cap, Hkv, hd) scatter-ready, page-chunked
+                        rows = cache[kind][i].transpose(0, 2, 1, 3)
+                        paged = rows.reshape(b * ppseq, ps, n_kv, hd)
+                        pool = new[f"cache_{kind}_{i}"]
+                        new[f"cache_{kind}_{i}"] = pool.at[flat_pages].set(
+                            paged.astype(pool.dtype), mode="drop"
+                        )
+                return first, new
+
+            fn = jax.jit(_fn, donate_argnums=(1,))
+            self._prefill_cache[(P, b)] = fn
+        first, self.pools = fn(prompt_ids, self.pools, jnp.asarray(pt_rows))
+        return first
+
+    # -- admission / retirement (between segments) -------------------------
+    def _admit(self) -> int:
+        """FIFO admission, batched: the longest same-prompt-length prefix
+        of the queue that fits the free slots and the page pool is
+        prefilled in one call.  Head-of-line blocking is deliberate —
+        admission order stays strict FIFO (no starvation of big
+        requests), batching only coalesces what FIFO would have admitted
+        anyway."""
+        from ..models.kv_pages import TRASH_PAGE, pages_needed
+
+        admitted = 0
+        while self._queue:
+            free_slots = [
+                s for s in range(self.slots) if self._slot_req[s] is None
+            ]
+            if not free_slots:
+                break
+            P = self._queue[0][1].shape[1]
+            batch, budget = [], self.pool.free_pages
+            for rid, ids, max_new in self._queue:
+                if ids.shape[1] != P or len(batch) >= len(free_slots):
+                    break
+                need = pages_needed(ids.shape[1] + max_new, self.page_size)
+                if need > budget:
+                    break
+                budget -= need
+                batch.append((rid, ids, max_new, need))
+            if not batch:
+                break  # backpressure: head waits for frees
+            del self._queue[:len(batch)]
+            pt_rows = self._np.full(
+                (len(batch), self.pages_per_seq), TRASH_PAGE, self._np.int32
+            )
+            page_lists = []
+            for j, (_, _, _, need) in enumerate(batch):
+                pages = self.pool.alloc(need)
+                page_lists.append(pages)
+                pt_rows[j, :need] = pages
+            first = self._prefill_scatter(
+                jnp.concatenate([ids for _, ids, _, _ in batch], axis=0),
+                pt_rows,
+            )
+            first = self._np.asarray(first)
+            for j, (rid, ids, max_new, _) in enumerate(batch):
+                s = free_slots[j]
+                self.page_table[s] = pt_rows[j]
+                self.lengths[s] = P
+                self.cur_tok[s, 0] = int(first[j])
+                self.remaining[s] = max_new - 1
+                self._slot_req[s] = rid
+                self._slot_pages[s] = page_lists[j]
+                self._tokens[rid] = [int(first[j])]
+                if max_new == 1:  # prefill produced the only token
+                    self._retire(s)
+            admitted += len(batch)
+        return admitted
+
+    def _retire(self, s: int) -> None:
+        rid = self._slot_req[s]
+        self.pool.free(self._slot_pages[s])
+        self.results[rid] = self._np.asarray(
+            self._tokens.pop(rid), dtype=self._np.int32
+        )
+        self._slot_req[s] = None
+        self._slot_pages[s] = []
+
+    # -- the serving loop --------------------------------------------------
+    def step_segment(self) -> int:
+        """Admit, run ONE K-step segment, fold tokens, retire finished
+        slots.  Returns the number of tokens delivered to requests."""
+        self._admit()
+        owed = self.remaining.copy()
+        if not owed.any():
+            return 0
+        toks, self.pools = self._seg(
+            self.pools, self.page_table, self.lengths,
+            self.cur_tok, self.remaining,
+        )
+        toks = self._np.asarray(toks)  # the one readback per segment
+        # slot state advances host-side: each slot ran min(owed, K)
+        # active steps, its current token is the last one it emitted
+        ran = self._np.minimum(owed, self.seg_steps)
+        self.lengths = self.lengths + ran
+        self.remaining = self._np.maximum(owed - self.seg_steps, 0)
+        delivered = 0
+        for s in range(self.slots):
+            rid = self._slot_req[s]
+            if rid is None:
+                continue
+            n = int(ran[s])
+            if n:
+                self._tokens[rid].extend(int(t) for t in toks[s, :n])
+                self.cur_tok[s, 0] = toks[s, n - 1]
+                delivered += n
+            if owed[s] <= self.seg_steps:
+                self._retire(s)
+        self.segments_run += 1
+        return delivered
+
+    def run(self) -> Dict[Any, Any]:
+        """Drain the queue and all active slots; returns {rid: np.int32
+        tokens} (prompt excluded; exactly ``max_new_tokens`` each)."""
+        while self._queue or any(r is not None for r in self._slot_req):
+            before = len(self.results)
+            self.step_segment()
+            if (
+                len(self.results) == before
+                and not any(r is not None for r in self._slot_req)
+            ):
+                raise RuntimeError(
+                    "engine stalled: queued requests cannot be admitted "
+                    f"({self.pool.free_pages} free pages)"
+                )
+        return self.results

@@ -1121,6 +1121,41 @@ class DeviceBackend:
             len(outputs) - n_ext, executed, {"loop_s": loop_s},
         )
 
+    def paged_decode_engine(
+        self,
+        graph: TaskGraph,
+        schedule: Schedule,
+        config: Any,
+        weights: Dict[str, Any],
+        pool: Any,
+        slots: int,
+        pages_per_seq: int,
+        seg_steps: int = 8,
+    ):
+        """Continuous-batching paged decode engine over a SCHEDULED paged
+        decode-step DAG (``frontend.build_paged_decode_dag``).
+
+        Runs the same static pre-execution gate as :meth:`execute` (the
+        DEC0xx decode-loop pass checks cache/page-table placement
+        coherence) before composing the placed step, so a schedule that
+        would mis-place the paged cache is rejected at build time, not
+        discovered as garbage tokens.  ``pool`` is the host-side
+        ``models.kv_pages.PagePool`` whose geometry must match the
+        graph's pool params.
+        """
+        if self.pre_analysis:
+            from ..analysis import pre_execution_gate
+
+            pre_execution_gate(
+                graph, self.cluster, schedule, backend="device"
+            )
+        from .decode_loop import PagedDecodeEngine
+
+        return PagedDecodeEngine(
+            graph, schedule, config, weights, pool,
+            slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
+        )
+
     def execute(
         self,
         graph: TaskGraph,

@@ -458,6 +458,224 @@ def build_backbone_decode_dag(
     return dag
 
 
+class PagedDecodeDAG(ModelDAG):
+    """ModelDAG for the paged decode step: inputs are ``{"ids": (S, 1)
+    int32, "lengths": (S,) int32}`` — per-slot ragged positions instead
+    of one shared scalar — and the KV cache params are shared page pools
+    plus the ``page_table`` param (:mod:`..models.kv_pages`)."""
+
+    slots: int = 1
+    page_size: int = 0
+    pages_per_seq: int = 0
+
+    def make_inputs(self, key: Optional[jax.Array] = None,
+                    lengths: Optional[Any] = None) -> Dict[str, jax.Array]:
+        key = key if key is not None else jax.random.PRNGKey(1)
+        shape = self.input_spec["ids"].shape
+        S = shape[0]
+        return {
+            "ids": jax.random.randint(
+                key, shape, 0, self.config.vocab_size, dtype=jnp.int32
+            ),
+            "lengths": (
+                jnp.zeros((S,), jnp.int32) if lengths is None
+                else jnp.asarray(lengths, jnp.int32)
+            ),
+        }
+
+
+def build_paged_decode_dag(
+    config: Optional[GPT2Config] = None,
+    slots: int = 4,
+    page_size: int = 16,
+    n_pages: int = 64,
+    pages_per_seq: int = 8,
+    effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
+) -> PagedDecodeDAG:
+    """Paged single-token decode step as a task DAG (GPT-2 family).
+
+    The dense decode DAG's per-layer ``cache_k_{i}``/``cache_v_{i}``
+    slabs become shared page POOLS ``(n_pages, page_size, H, hd)`` and
+    every layer task additionally aliases the ``page_table`` param
+    ``(slots, pages_per_seq) int32`` — so placement and the analysis
+    passes see the paged cache's real residency: the pool bytes are the
+    per-layer page residency, and the table is the tiny shared indirection
+    every layer reads (the DEC003 wiring contract).  Attention is the
+    ragged paged op (:func:`...ops.attention.paged_decode_attention`):
+    gathered by page table, masked per-slot at the runtime ``lengths``
+    input, bit-identical to a dense cache of capacity ``pages_per_seq *
+    page_size``.
+
+    The step is scheduler-placed exactly like the dense decode DAG; the
+    continuous-batching loop (``backends/decode_loop.py``) composes it
+    into scanned K-step segments.
+    """
+    from ..models.kv_pages import TRASH_PAGE, init_paged_kv
+    from ..ops.attention import paged_decode_attention
+
+    config = config or GPT2Config.tiny()
+    if n_pages < 2:
+        raise ValueError(f"n_pages must be >= 2 (page 0 is reserved), "
+                         f"got {n_pages}")
+    S, D, H = slots, config.n_embd, config.n_head
+    hd, ps = config.head_dim, page_size
+    M = pages_per_seq * page_size  # per-slot gathered capacity
+    eps = config.ln_eps
+    scale = 1.0 / math.sqrt(hd)
+
+    specs = {
+        name: jax.ShapeDtypeStruct(shape, dtype)
+        for name, (shape, dtype) in gpt2.param_shapes(config).items()
+    }
+    for i in range(config.n_layer):
+        for kind in ("k", "v"):
+            specs[f"cache_{kind}_{i}"] = jax.ShapeDtypeStruct(
+                (n_pages, ps, H, hd), config.dtype
+            )
+    specs["page_table"] = jax.ShapeDtypeStruct((S, pages_per_seq), jnp.int32)
+    input_spec = {
+        "ids": jax.ShapeDtypeStruct((S, 1), jnp.int32),
+        "lengths": jax.ShapeDtypeStruct((S,), jnp.int32),
+    }
+
+    tasks: List[Task] = []
+    out_specs: Dict[str, Any] = {}
+    add = make_task_adder(tasks, out_specs, specs, input_spec, effective_flops)
+
+    def f_embed(p, inputs):
+        # per-slot position rows: slot s sits at its own lengths[s]
+        lengths = inputs["lengths"]
+        wpe_rows = jnp.take(p["wpe"], lengths, axis=0)[:, None, :]
+        return {
+            "x": p["wte"][inputs["ids"]] + wpe_rows,
+            "lengths": lengths,
+        }
+
+    def f_layer(p, prev):
+        """One paged cached layer: ragged paged attention over the shared
+        pools (this step's k/v inserted into the gathered view — the
+        pool write itself is the loop composer's fold), then the MLP."""
+        x, lengths = prev["x"], prev["lengths"]
+        ln1 = gpt2.layer_norm(x, p["ln1_g"], p["ln1_b"], eps)
+        qkv = ln1 @ p["qkv_w"] + p["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(S, 1, H, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        att = paged_decode_attention(
+            q, p["cache_k"], p["cache_v"], p["page_table"], lengths,
+            scale, k_new=k, v_new=v,
+        )
+        att = att.transpose(0, 2, 1, 3).reshape(S, 1, D)
+        x = x + (att @ p["attn_proj_w"] + p["attn_proj_b"])
+        ln2 = gpt2.layer_norm(x, p["ln2_g"], p["ln2_b"], eps)
+        h = gpt2.ffn_contract(
+            gpt2.ffn_activation(
+                gpt2.ffn_expand(ln2, p["fc_w"], p["fc_b"])
+            ),
+            p["mlp_proj_w"], p["mlp_proj_b"],
+        )
+        return {"x": x + h, "k_new": k, "v_new": v, "lengths": lengths}
+
+    def f_head(p, prev):
+        x = gpt2.layer_norm(prev["x"], p["ln_f_g"], p["ln_f_b"], eps)
+        return gpt2.output_projection(x, p["wte"])
+
+    add("embed", f_embed, [], {"wte": "wte", "wpe": "wpe"},
+        2.0 * S * D, "embed")
+    prev = "embed"
+    for i in range(config.n_layer):
+        pre = f"h{i}_"
+        alias = {
+            "ln1_g": pre + "ln1_g", "ln1_b": pre + "ln1_b",
+            "qkv_w": pre + "attn_qkv_w", "qkv_b": pre + "attn_qkv_b",
+            "attn_proj_w": pre + "attn_proj_w",
+            "attn_proj_b": pre + "attn_proj_b",
+            "ln2_g": pre + "ln2_g", "ln2_b": pre + "ln2_b",
+            "fc_w": pre + "mlp_fc_w", "fc_b": pre + "mlp_fc_b",
+            "mlp_proj_w": pre + "mlp_proj_w",
+            "mlp_proj_b": pre + "mlp_proj_b",
+            "cache_k": f"cache_k_{i}", "cache_v": f"cache_v_{i}",
+            "page_table": "page_table",
+        }
+        # attention gathers the slot's full paged capacity every step
+        flops = (
+            2.0 * S * D * 3 * D
+            + 2.0 * 2.0 * S * H * M * hd
+            + 2.0 * S * D * D
+            + 2.0 * S * D * 4 * D * 2
+        )
+        tid = f"layer_{i}"
+        add(tid, f_layer, [prev], alias, flops, f"layer_{i}")
+        prev = tid
+    add("logits", f_head, [prev], {
+        "ln_f_g": "ln_f_g", "ln_f_b": "ln_f_b", "wte": "wte",
+    }, 2.0 * S * D * config.vocab_size, "head")
+
+    name = (
+        f"gpt2paged_{config.n_layer}l_d{D}_s{S}_ps{ps}_p{n_pages}"
+        + ("" if config.dtype == jnp.float32
+           else f"_{jnp.dtype(config.dtype).name}")
+    )
+
+    def init_fn(key):
+        params = gpt2.init_params(config, key)
+        params.update(init_paged_kv(
+            config.n_layer, n_pages, ps, H, hd, config.dtype
+        ))
+        params["page_table"] = jnp.full(
+            (S, pages_per_seq), TRASH_PAGE, jnp.int32
+        )
+        return params
+
+    def reference_forward(params, inputs):
+        """Independent oracle: per-slot DENSE cached forward — gather
+        each slot's pages into a dense (1, H, M, hd) cache and run the
+        family's ``forward_cached`` at that slot's position.  Slow
+        (python loop over slots) but shares no code with the paged op."""
+        from ..models.kv_pages import gather_kv
+
+        model_params = {
+            k: v for k, v in params.items()
+            if not k.startswith("cache_") and k != "page_table"
+        }
+        pt = params["page_table"]
+        outs = []
+        for s in range(S):
+            cache = {
+                "k": jnp.stack([
+                    gather_kv(params[f"cache_k_{i}"], pt[s:s + 1])
+                    for i in range(config.n_layer)
+                ]),
+                "v": jnp.stack([
+                    gather_kv(params[f"cache_v_{i}"], pt[s:s + 1])
+                    for i in range(config.n_layer)
+                ]),
+            }
+            logits, _ = gpt2.forward_cached(
+                model_params, inputs["ids"][s:s + 1], cache,
+                inputs["lengths"][s], config,
+            )
+            outs.append(logits)
+        return jnp.concatenate(outs, axis=0)
+
+    graph = TaskGraph(tasks, name=name).freeze()
+    dag = PagedDecodeDAG(
+        graph=graph,
+        config=config,
+        input_spec=input_spec,
+        param_specs=specs,
+        reference_forward=reference_forward,
+        init_fn=init_fn,
+    )
+    dag.slots = S
+    dag.page_size = ps
+    dag.pages_per_seq = pages_per_seq
+    return dag
+
+
 def build_decode_dag_any(config: Any, **kw) -> ModelDAG:
     """Family-dispatching decode-step DAG builder: GPT-2 configs go to
     :func:`build_decode_dag`, Llama/Mixtral to

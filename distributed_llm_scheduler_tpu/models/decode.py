@@ -194,9 +194,14 @@ def _decode_attention_natural(
     effective on the v5e, ~1/5 of what the chip streams at these shapes.
     Computing scores as ``K @ q`` instead ((B, Hkv, M, G) with M on
     sublanes, exactly the cache's storage layout) runs the identical
-    math at 576 GB/s (0.81 -> 0.29 ms/step on the 12-layer flagship
-    attribution; artifact pending recapture).  A Pallas per-layer kernel
-    was tried first
+    math at 576 GB/s (0.81 -> 0.29 ms/step on the 12-layer flagship, a
+    same-session v5e probe; the committed ``DECODE_r04.json``
+    attribution predates the fix and shows the transposing form at
+    1.91 ms — 10.9% of its byte bound.  The r6 recapture ran on a host
+    core, where the shipped orientation measures 4.7 of the 251 ms CPU
+    step — ``DECODE_r06.json`` ``attribution.attn_ms`` — attention is a
+    ~2% slice there, so the GB/s ratio above stays v5e-attributed).  A
+    Pallas per-layer kernel was tried first
     and LOST: ~66 us fixed cost per pallas_call x 12 sequential layers
     swamps any in-kernel win — the right decode kernel here is the one
     XLA already has, fed shapes in its preferred orientation.

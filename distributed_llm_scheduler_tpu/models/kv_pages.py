@@ -1,0 +1,332 @@
+"""Block-allocated KV-cache pool: fixed-size pages + per-sequence tables.
+
+The dense decode cache (:mod:`.decode`) reserves ``max_len`` rows for
+every batch slot up front, so serving mixed-length traffic pays HBM for
+the LONGEST request times the whole batch.  This module supplies the
+vLLM-style alternative the Ragged Paged Attention line of work makes
+TPU-native (PAPERS.md, arxiv 2604.15464): cache rows live in fixed-size
+**pages** drawn from one shared pool, each sequence holds a **page
+table** (logical page index -> physical page id), and a host-side
+free-list allocator recycles pages as requests retire — so the pool is
+sized for the *working set*, not ``slots x max_len``.
+
+Three pieces, split by where they run:
+
+* :class:`PagePool` — host-side free-list allocator with an
+  HBM-budget-accounted capacity (``PagePool.from_budget`` sizes the pool
+  off the device's reported memory via
+  :func:`..utils.costmodel.device_hbm_bytes`).  Pure Python; never
+  traced.
+* :func:`init_paged_kv` — the device-side per-layer page pools
+  (``(n_pages, page_size, n_kv_heads, head_dim)`` — the kernel-natural
+  layout the ragged-paged-attention TPU kernels consume, pages on the
+  leading axis so one gather assembles a sequence).
+* scatter helpers (:func:`write_token_kv`, :func:`write_prompt_kv`) —
+  static-shape jittable writes: one token's K/V row into its page slot
+  (traced page id + slot), or a whole prefilled prompt page-reshaped
+  into its allocated pages.
+
+Physical page 0 is RESERVED as the trash page: unallocated page-table
+entries point at it, and inactive batch slots redirect their writes to
+it, so scatters never need a dynamic shape and gathers of a sequence's
+unused tail read finite (masked-out) garbage instead of faulting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+#: Default tokens per page.  16 keeps page-granularity waste under one
+#: MXU sublane tile at bf16 while still amortizing the table indirection.
+DEFAULT_PAGE_SIZE = 16
+
+#: Physical page id reserved for unallocated table entries and inactive
+#: slot writes (never handed out by the allocator).
+TRASH_PAGE = 0
+
+
+def pages_needed(n_tokens: int, page_size: int) -> int:
+    """Pages covering ``n_tokens`` rows (ceil division)."""
+    if n_tokens < 0:
+        raise ValueError(f"n_tokens must be >= 0, got {n_tokens}")
+    return -(-n_tokens // page_size)
+
+
+def pool_bytes_per_layer(
+    n_pages: int, page_size: int, n_kv_heads: int, head_dim: int, dtype: Any
+) -> int:
+    """HBM bytes of ONE layer's K+V pools at this geometry."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return 2 * n_pages * page_size * n_kv_heads * head_dim * itemsize
+
+
+@dataclass
+class PagePool:
+    """Host-side free-list page allocator over ``n_pages`` physical pages.
+
+    Page ids are ints in ``[1, n_pages)`` — id 0 is :data:`TRASH_PAGE`
+    and is never allocated.  ``alloc``/``free`` are O(k); exhaustion
+    raises so callers (the continuous-batching engine) can hold requests
+    queued instead of silently corrupting the pool — backpressure, not
+    clamping.
+    """
+
+    n_pages: int
+    page_size: int = DEFAULT_PAGE_SIZE
+    _free: List[int] = field(default_factory=list, repr=False)
+    _allocated: set = field(default_factory=set, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.n_pages < 2:
+            raise ValueError(
+                f"pool needs >= 2 pages (one is the reserved trash page), "
+                f"got {self.n_pages}"
+            )
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        # LIFO free list: recently-freed pages are re-issued first, which
+        # keeps the hot working set compact
+        self._free = list(range(self.n_pages - 1, TRASH_PAGE, -1))
+
+    @classmethod
+    def from_budget(
+        cls,
+        budget_bytes: int,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype: Any,
+        page_size: int = DEFAULT_PAGE_SIZE,
+    ) -> "PagePool":
+        """Size the pool so ALL layers' K+V pools fit ``budget_bytes``.
+
+        The budget is typically a fraction of
+        :func:`..utils.costmodel.device_hbm_bytes` — the costmodel owns
+        what the device reports, this allocator owns staying under it.
+        """
+        per_page = n_layers * pool_bytes_per_layer(
+            1, page_size, n_kv_heads, head_dim, dtype
+        )
+        n_pages = int(budget_bytes // per_page)
+        if n_pages < 2:
+            raise ValueError(
+                f"budget {budget_bytes} bytes fits {n_pages} page(s); "
+                f"need >= 2 ({per_page} bytes/page across {n_layers} "
+                "layers)"
+            )
+        return cls(n_pages=n_pages, page_size=page_size)
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._allocated)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    # -- alloc / free ------------------------------------------------------
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages off the free list; raises on exhaustion
+        (callers queue the request — the pool never over-allocates)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            raise MemoryError(
+                f"page pool exhausted: want {n}, have {len(self._free)} "
+                f"free of {self.n_pages - 1} allocatable"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def alloc_for_tokens(self, n_tokens: int) -> List[int]:
+        return self.alloc(pages_needed(n_tokens, self.page_size))
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Return pages to the free list; double-free and trash-page
+        frees are hard errors (a silent one would hand the same page to
+        two sequences)."""
+        for p in pages:
+            if p == TRASH_PAGE:
+                raise ValueError("page 0 is reserved and never allocated")
+            if p not in self._allocated:
+                raise ValueError(f"double free of page {p}")
+            self._allocated.discard(p)
+            self._free.append(p)
+
+
+def init_paged_kv(
+    n_layers: int,
+    n_pages: int,
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    dtype: Any,
+) -> Dict[str, jax.Array]:
+    """Zeroed per-layer page pools keyed ``cache_k_{i}`` / ``cache_v_{i}``
+    — the same naming contract the dense decode DAG uses, so
+    ``split_cache_params`` and the analysis passes treat paged and dense
+    caches uniformly.  Layout ``(n_pages, page_size, n_kv_heads,
+    head_dim)``: pages lead, so assembling a sequence is one gather on
+    axis 0."""
+    shape = (n_pages, page_size, n_kv_heads, head_dim)
+    out: Dict[str, jax.Array] = {}
+    for i in range(n_layers):
+        out[f"cache_k_{i}"] = jnp.zeros(shape, dtype)
+        out[f"cache_v_{i}"] = jnp.zeros(shape, dtype)
+    return out
+
+
+def page_table_array(
+    tables: Sequence[Sequence[int]], pages_per_seq: int
+) -> jax.Array:
+    """Stack per-sequence page-id lists into the device table
+    ``(slots, pages_per_seq) int32``, padding unallocated entries with
+    the trash page."""
+    rows = []
+    for t in tables:
+        if len(t) > pages_per_seq:
+            raise ValueError(
+                f"sequence holds {len(t)} pages > pages_per_seq "
+                f"{pages_per_seq}"
+            )
+        rows.append(list(t) + [TRASH_PAGE] * (pages_per_seq - len(t)))
+    return jnp.asarray(rows, jnp.int32)
+
+
+def write_token_kv(
+    pool: jax.Array,
+    new: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    active: jax.Array,
+) -> jax.Array:
+    """Scatter one step's K (or V) rows into their page slots.
+
+    ``pool`` (P, ps, Hkv, hd); ``new`` (S, Hkv, 1, hd) — this step's row
+    per slot; ``page_table`` (S, pages_per_seq) int32; ``lengths`` (S,)
+    int32 — tokens already cached per slot (the write position);
+    ``active`` (S,) bool.  Inactive slots write the trash page, so the
+    scatter stays static-shape under an admission/retirement mask.
+    """
+    n_pages, ps = pool.shape[0], pool.shape[1]
+    s_idx = jnp.arange(page_table.shape[0])
+    logical = jnp.where(active, lengths // ps, 0)
+    pid = jnp.where(active, page_table[s_idx, logical], TRASH_PAGE)
+    slot = jnp.where(active, lengths % ps, 0)
+    rows = new[:, :, 0, :].astype(pool.dtype)  # (S, Hkv, hd)
+    # flat row index: one 1-D scatter instead of a 2-D one (inactive
+    # slots land in the trash page's row 0)
+    flat = pool.reshape(n_pages * ps, *pool.shape[2:])
+    flat = flat.at[pid * ps + slot].set(rows, mode="drop")
+    return flat.reshape(pool.shape)
+
+
+def write_prompt_kv(
+    pool: jax.Array, rows: jax.Array, pages: jax.Array
+) -> jax.Array:
+    """Scatter a prefilled prompt's rows into a sequence's pages.
+
+    ``rows`` (cap, Hkv, hd) — the sequence's cache rows padded to its
+    full page capacity ``cap = len(pages) * page_size``; ``pages``
+    (n_pages_seq,) int32 physical ids (tail entries may be the trash
+    page — overwriting it is harmless by design).
+    """
+    n_pg = pages.shape[0]
+    ps = pool.shape[1]
+    if rows.shape[0] != n_pg * ps:
+        raise ValueError(
+            f"rows cover {rows.shape[0]} tokens, pages cover {n_pg * ps}"
+        )
+    paged = rows.reshape(n_pg, ps, *rows.shape[1:]).astype(pool.dtype)
+    return pool.at[pages].set(paged, mode="drop")
+
+
+def gather_kv(
+    pool: jax.Array, page_table: jax.Array
+) -> jax.Array:
+    """Assemble per-sequence contiguous KV views from the pool.
+
+    ``pool`` (P, ps, Hkv, hd), ``page_table`` (S, n_pg) ->
+    ``(S, Hkv, n_pg * ps, hd)`` — the dense-cache orientation
+    (:func:`..models.decode.cached_attention`), so downstream attention
+    math is shared verbatim with the dense path.  Unallocated table
+    entries gather the trash page; its rows are masked by the caller's
+    per-sequence lengths.
+
+    Pays a materializing transpose to reach the dense orientation —
+    right for oracles and tests; the hot attention path uses
+    :func:`gather_kv_flat` instead.
+    """
+    S, n_pg = page_table.shape
+    ps, hkv, hd = pool.shape[1], pool.shape[2], pool.shape[3]
+    pages = jnp.take(pool, page_table.reshape(-1), axis=0)
+    view = pages.reshape(S, n_pg, ps, hkv, hd)
+    return view.transpose(0, 3, 1, 2, 4).reshape(S, hkv, n_pg * ps, hd)
+
+
+def gather_kv_flat(
+    pool: jax.Array, page_table: jax.Array
+) -> jax.Array:
+    """Token-major per-sequence view: ``(S, n_pg * ps, Hkv, hd)``.
+
+    Same gather as :func:`gather_kv` but WITHOUT the transpose to the
+    dense orientation — the reshape is free on the gather's contiguous
+    output (pages arrive token-major already), so this is the layout the
+    per-step XLA attention path uses; the caller permutes its
+    ``dot_general`` batch dims instead of the data.  Token order is
+    identical to the dense view's, so score/softmax reductions see the
+    same operands in the same logical order (the bitwise-parity
+    invariant the op tests pin).
+    """
+    S, n_pg = page_table.shape
+    ps, hkv, hd = pool.shape[1], pool.shape[2], pool.shape[3]
+    pages = jnp.take(pool, page_table.reshape(-1), axis=0)
+    return pages.reshape(S, n_pg * ps, hkv, hd)
+
+
+def paged_param_bytes(
+    n_layers: int,
+    n_pages: int,
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    dtype: Any,
+    slots: int,
+    pages_per_seq: int,
+) -> Dict[str, int]:
+    """Byte sizes of every paged-cache param the decode DAG declares —
+    the page-residency numbers placement and the DEC analysis pass see."""
+    per_pool = pool_bytes_per_layer(
+        n_pages, page_size, n_kv_heads, head_dim, dtype
+    ) // 2
+    out: Dict[str, int] = {}
+    for i in range(n_layers):
+        out[f"cache_k_{i}"] = per_pool
+        out[f"cache_v_{i}"] = per_pool
+    out["page_table"] = slots * pages_per_seq * 4
+    return out
+
+
+__all__ = [
+    "DEFAULT_PAGE_SIZE",
+    "TRASH_PAGE",
+    "PagePool",
+    "pages_needed",
+    "pool_bytes_per_layer",
+    "init_paged_kv",
+    "page_table_array",
+    "write_token_kv",
+    "write_prompt_kv",
+    "gather_kv",
+    "gather_kv_flat",
+    "paged_param_bytes",
+]

@@ -222,6 +222,104 @@ def mha(
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    sm_scale: Optional[float] = None,
+    k_new: Optional[jax.Array] = None,
+    v_new: Optional[jax.Array] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Ragged paged single-token attention: gather-by-page-table,
+    per-sequence length-masked, static shapes throughout.
+
+    ``q`` (S, Hq, 1, hd) — one new token per batch slot; ``k_pool`` /
+    ``v_pool`` (P, page_size, Hkv, hd) — the shared page pools
+    (:mod:`..models.kv_pages`); ``page_table`` (S, pages_per_seq) int32
+    — slot ``s``'s logical page ``j`` lives in physical page
+    ``page_table[s, j]``; ``lengths`` (S,) int32 — tokens already cached
+    per slot.  ``k_new``/``v_new`` (S, Hkv, 1, hd), when given, are this
+    step's rows, inserted into the gathered view at ``lengths[s]``
+    BEFORE the scores — the write-then-attend order of the dense path
+    (:func:`...models.decode.cached_attention`), so outputs are
+    bit-identical to a dense cache of the same per-sequence capacity.
+    Slot ``s`` attends positions ``m <= lengths[s]``; rows past a
+    sequence's last allocated page gather the trash page and are masked
+    by the same comparison.
+
+    The math after the gather is the dense decode path's MXU-natural
+    orientation (``_decode_attention_natural``: K @ q, scores
+    (S, Hkv, M, G), softmax over M) — deliberately, for two reasons:
+    scores are elementwise identical to the dense cache's (the parity
+    the mixed-length benchmark gates on), and the (pages, page_size)
+    leading axes of the pools are exactly the block structure a Pallas
+    ragged-paged-attention kernel consumes, so the kernel drops in
+    behind ``impl="pallas"`` without changing this contract.  Until
+    then ``impl`` accepts "xla" (default); "pallas" raises.
+    """
+    if impl is None:
+        impl = "xla"
+    if impl != "xla":
+        raise NotImplementedError(
+            f"paged attention impl {impl!r}: only the XLA path exists; "
+            "the Pallas ragged kernel slots in behind this signature "
+            "(pools are already page-blocked on the leading axes)"
+        )
+    from ..models.kv_pages import gather_kv_flat  # lazy: models imports ops
+
+    S, Hq, Tn, hd = q.shape
+    if Tn != 1:
+        raise ValueError(f"paged decode attention is single-token, Tn={Tn}")
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    # flat (S, M, Hkv, hd) gather: a free reshape of the page gather's
+    # output, where the dense (S, Hkv, M, hd) orientation would pay a
+    # materializing transpose of the whole working set every step.  The
+    # dot_general batch dims below are permuted to match — contraction
+    # and softmax reductions see the SAME operands in the SAME logical
+    # order, so outputs stay bit-identical to the dense-orientation math
+    # (pinned by the parity tests).
+    k_view = gather_kv_flat(k_pool, page_table)  # (S, M, Hkv, hd)
+    v_view = gather_kv_flat(v_pool, page_table)
+    M, Hkv = k_view.shape[1], k_view.shape[2]
+    G = Hq // Hkv
+
+    if k_new is not None:
+        insert = jax.vmap(
+            lambda buf, row, at: jax.lax.dynamic_update_slice(
+                buf, row.transpose(1, 0, 2).astype(buf.dtype),
+                (at, jnp.int32(0), jnp.int32(0)),
+            )
+        )
+        # (S, Hkv, 1, hd) rows land at per-sequence position lengths[s]
+        k_view = insert(k_view, k_new, lengths)
+        v_view = insert(v_view, v_new, lengths)
+
+    qg = (q * scale).reshape(S, Hkv, G, hd)
+    s = jax.lax.dot_general(
+        k_view.astype(qg.dtype), qg,
+        (((3,), (3,)), ((0, 2), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )  # (S, Hkv, M, G)
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    valid = rows <= lengths.reshape(S, 1, 1, 1)
+    s = jnp.where(valid, s, jnp.finfo(s.dtype).min)
+    m = s.max(axis=2, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=2, keepdims=True)
+    out_dtype = q.dtype
+    o = jax.lax.dot_general(
+        p.astype(out_dtype), v_view.astype(out_dtype),
+        (((2,), (1,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.float32,
+    )
+    return (o / l.reshape(S, Hkv, G, 1)).astype(out_dtype).reshape(
+        S, Hq, 1, hd
+    )
+
+
 def gqa_mha(
     q: jax.Array,
     k: jax.Array,

@@ -91,6 +91,27 @@ class CostModel:
         )
 
 
+def device_hbm_bytes(device: Any = None, default: int = 8 << 30) -> int:
+    """Usable accelerator memory in bytes for KV-budget sizing
+    (``models.kv_pages.PagePool.from_budget``).
+
+    Reads the device's ``memory_stats()`` byte limit when the platform
+    reports one (TPU/GPU runtimes do); CPU and simulator backends report
+    nothing, so ``default`` stands in — sizing decisions stay explicit in
+    the caller rather than guessed per-platform here.
+    """
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        stats = {}
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    return int(limit) if limit else default
+
+
 def readback_fence(x: Any) -> None:
     """Force TRUE completion of ``x``: device->host readback of a dependent
     element.

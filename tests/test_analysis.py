@@ -268,6 +268,138 @@ def test_quantize_dag_output_lints_clean():
     assert rep.ok, rep.render()
 
 
+# -- pass 7: decode-loop composability ---------------------------------------
+
+def decode_graph(pool_bytes_1=1024):
+    """Two-layer decode-ish graph: each layer aliases its own cache pool
+    plus the shared page_table (the paged wiring contract)."""
+    pb = {"page_table": 64}
+    return TaskGraph([
+        Task("embed", 0.1, 1.0, [], set()),
+        Task("l0", 0.1, 1.0, ["embed"], {"cache_k_0", "page_table"},
+             param_bytes={"cache_k_0": 1024, **pb}),
+        Task("l1", 0.1, 1.0, ["l0"], {"cache_k_1", "page_table"},
+             param_bytes={"cache_k_1": pool_bytes_1, **pb}),
+        Task("logits", 0.1, 1.0, ["l1"], set()),
+    ])
+
+
+def test_decode_pass_noop_without_cache_params():
+    from distributed_llm_scheduler_tpu.analysis import analyze_decode
+
+    g = TaskGraph([Task("a", 0.1, 1.0, [], {"w"})])
+    assert analyze_decode(g, two_caps(), sched({"n0": ["a"]})).diagnostics == []
+
+
+def test_decode_pass_clean_single_node_and_residency_info():
+    from distributed_llm_scheduler_tpu.analysis import analyze_decode
+
+    g = decode_graph()
+    rep = analyze_decode(
+        g, two_caps(), sched({"n0": ["embed", "l0", "l1", "logits"]})
+    )
+    assert rep.ok and not rep.warnings
+    (info,) = rep.by_code("DEC004")
+    assert info.data["paged"] and info.data["kv_bytes"] == 2048
+
+
+def test_decode_pass_dec001_cache_alias_across_nodes():
+    from distributed_llm_scheduler_tpu.analysis import analyze_decode
+
+    g = TaskGraph([
+        Task("l0", 0.1, 1.0, [], {"cache_k_0"},
+             param_bytes={"cache_k_0": 1024}),
+        Task("l1", 0.1, 1.0, ["l0"], {"cache_k_0"},
+             param_bytes={"cache_k_0": 1024}),
+    ])
+    s = sched({"n0": ["l0"], "n1": ["l1"]})
+    rep = analyze_decode(g, two_caps(), s)
+    (d,) = rep.by_code("DEC001")
+    assert d.param == "cache_k_0" and d.data["nodes"] == ["n0", "n1"]
+    with pytest.raises(AnalysisError):  # gated on both backends
+        pre_execution_gate(g, two_caps(), s, backend="device")
+
+
+def test_decode_pass_dec002_multi_node_is_warning_only():
+    g = decode_graph()
+    s = sched({"n0": ["embed", "l0"], "n1": ["l1", "logits"]})
+    from distributed_llm_scheduler_tpu.analysis import analyze_decode
+
+    rep = analyze_decode(g, two_caps(), s)
+    assert rep.ok and rep.has("DEC002")  # dispatchable, scan-ineligible
+    assert pre_execution_gate(g, two_caps(), s, backend="device").ok
+
+
+def test_decode_pass_dec003_wiring():
+    from distributed_llm_scheduler_tpu.analysis import analyze_decode
+
+    # pools without the table / table without pools
+    g = TaskGraph([
+        Task("l0", 0.1, 1.0, [], {"cache_k_0"}),
+        Task("l1", 0.1, 1.0, ["l0"], {"page_table"}),
+    ])
+    rep = analyze_decode(g)
+    assert {d.task for d in rep.by_code("DEC003")} == {"l0", "l1"}
+    # pool geometry mismatch across layers
+    rep2 = analyze_decode(decode_graph(pool_bytes_1=2048))
+    assert any("geometry" in d.message for d in rep2.by_code("DEC003"))
+
+
+def test_paged_dag_lints_clean_on_one_node():
+    """The real paged builder + a single-node schedule must produce no
+    errors or warnings from the decode pass (the engine's own gate)."""
+    from distributed_llm_scheduler_tpu.analysis import analyze_decode
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+    from distributed_llm_scheduler_tpu.sched.policies import get_scheduler
+
+    dag = build_paged_decode_dag(GPT2Config.tiny(), slots=2, page_size=4,
+                                 n_pages=8, pages_per_seq=4)
+    cluster = Cluster([DeviceState("n0", 64.0)])
+    s = get_scheduler("greedy").schedule(dag.graph, cluster)
+    rep = analyze_decode(dag.graph, cluster, s)
+    assert rep.ok and not rep.warnings, rep.render()
+    assert rep.by_code("DEC004")[0].data["paged"]
+
+
+# -- mechanical fixes (lint --fix) -------------------------------------------
+
+def test_fix_duplicate_dependencies_preserves_arity():
+    from distributed_llm_scheduler_tpu.analysis import (
+        fix_duplicate_dependencies,
+    )
+
+    g = TaskGraph([
+        Task("a", 0.1, 1.0, [], set()),
+        Task("b", 0.1, 1.0, ["a", "a"], set()),
+    ])
+    assert analyze_graph(g).has("DAG003")
+    fixed = fix_duplicate_dependencies(g)
+    assert fixed == ["b"]
+    t = g["b"]
+    assert t.dependencies == ["a"]          # edges deduplicated ...
+    assert t.arg_tasks == ["a", "a"]        # ... fn call arity pinned
+    assert not analyze_graph(g).has("DAG003")
+    assert fix_duplicate_dependencies(g) == []  # idempotent
+
+
+def test_fix_duplicate_dependencies_rebuilds_frozen_edges():
+    from distributed_llm_scheduler_tpu.analysis import (
+        fix_duplicate_dependencies,
+    )
+
+    g = TaskGraph([
+        Task("a", 0.1, 1.0, [], set()),
+        Task("b", 0.1, 1.0, ["a", "a"], set()),
+        Task("c", 0.1, 1.0, ["b"], set()),
+    ]).freeze()
+    assert fix_duplicate_dependencies(g) == ["b"]
+    assert g.topo_order == ["a", "b", "c"]
+    assert g.dependents("a") == ["b"]  # stale duplicate edge rebuilt away
+
+
 # -- pre-execution gate ------------------------------------------------------
 
 def corrupted():

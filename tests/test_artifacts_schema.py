@@ -88,6 +88,18 @@ def test_decode_artifact_schema():
     # tp leg: either a real multi-device measurement or an honest skip
     tp = d.get("tp_sharded")
     assert tp and ("skipped" in tp or "tok_s_end_to_end" in tp), path
+    paged = d.get("paged")
+    if paged is not None:  # paged continuous-batching leg added r6
+        assert "error" not in paged, path
+        for k in ("n_requests", "slots", "page_size", "pages_per_seq",
+                  "capacity", "useful_tokens", "dense_tok_s",
+                  "paged_tok_s", "speedup", "tokens_exact",
+                  "pages_leaked"):
+            assert k in paged, (path, k)
+        # the r6 gates: bit-exact tokens, no leaked pages, >= dense rate
+        assert paged["tokens_exact"] is True, path
+        assert paged["pages_leaked"] == 0, path
+        assert paged["speedup"] >= 1.0, path
 
 
 def test_train_artifact_schema():

@@ -1,0 +1,312 @@
+"""Paged KV cache (models/kv_pages.py + ops.paged_decode_attention +
+backends.PagedDecodeEngine).
+
+Pins: the free-list allocator's backpressure contract (exhaustion raises,
+double-free raises, budget sizing); scatter/gather round-trips through
+the page indirection; ragged paged attention is BITWISE equal to the
+dense decode attention at every per-slot length (the parity the decode
+benchmark gates on); and the continuous-batching engine emits exactly
+the tokens ``generate`` would, per request, under admission/retirement
+churn with zero leaked pages.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, DeviceState, get_scheduler
+from distributed_llm_scheduler_tpu.models.kv_pages import (
+    DEFAULT_PAGE_SIZE,
+    TRASH_PAGE,
+    PagePool,
+    gather_kv,
+    gather_kv_flat,
+    init_paged_kv,
+    page_table_array,
+    pages_needed,
+    pool_bytes_per_layer,
+    write_prompt_kv,
+    write_token_kv,
+)
+
+
+# -- allocator --------------------------------------------------------------
+
+def test_pool_reserves_trash_page():
+    pool = PagePool(n_pages=8, page_size=4)
+    assert pool.free_pages == 7  # page 0 never handed out
+    got = pool.alloc(7)
+    assert TRASH_PAGE not in got
+    assert sorted(got) == list(range(1, 8))
+
+
+def test_alloc_free_recycles_lifo():
+    pool = PagePool(n_pages=8, page_size=4)
+    a = pool.alloc(3)
+    pool.free(a)
+    b = pool.alloc(3)
+    assert b == list(reversed(a))  # most-recently-freed first
+    assert pool.used_pages == 3 and pool.free_pages == 4
+
+
+def test_exhaustion_raises_not_clamps():
+    pool = PagePool(n_pages=4, page_size=4)
+    pool.alloc(3)
+    assert not pool.can_alloc(1)
+    with pytest.raises(MemoryError, match="exhausted"):
+        pool.alloc(1)
+
+
+def test_double_free_and_trash_free_raise():
+    pool = PagePool(n_pages=4, page_size=4)
+    pages = pool.alloc(2)
+    pool.free(pages)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([pages[0]])
+    with pytest.raises(ValueError, match="reserved"):
+        pool.free([TRASH_PAGE])
+
+
+def test_pages_needed_ceil():
+    assert pages_needed(0, 16) == 0
+    assert pages_needed(1, 16) == 1
+    assert pages_needed(16, 16) == 1
+    assert pages_needed(17, 16) == 2
+    with pytest.raises(ValueError):
+        pages_needed(-1, 16)
+
+
+def test_from_budget_accounts_all_layers():
+    # budget for exactly 10 pages across 4 layers of K+V pools
+    per_page = 4 * pool_bytes_per_layer(1, 16, 2, 8, jnp.float32)
+    pool = PagePool.from_budget(10 * per_page, 4, 2, 8, jnp.float32,
+                                page_size=16)
+    assert pool.n_pages == 10 and pool.free_pages == 9
+    with pytest.raises(ValueError, match="fits"):
+        PagePool.from_budget(per_page, 4, 2, 8, jnp.float32, page_size=16)
+
+
+def test_device_hbm_bytes_is_positive():
+    from distributed_llm_scheduler_tpu.utils.costmodel import device_hbm_bytes
+
+    assert device_hbm_bytes(jax.devices()[0]) > 0
+    assert device_hbm_bytes(None) > 0
+
+
+# -- scatter / gather -------------------------------------------------------
+
+def _rand(key, shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+
+
+def test_prompt_write_gather_roundtrip():
+    ps, hkv, hd = 4, 2, 8
+    pool_arr = jnp.zeros((8, ps, hkv, hd), jnp.float32)
+    rows = _rand(0, (2 * ps, hkv, hd))
+    pt = page_table_array([[3, 5]], pages_per_seq=4)
+    pool_arr = write_prompt_kv(pool_arr, rows, jnp.asarray([3, 5]))
+    view = gather_kv(pool_arr, pt)  # (1, hkv, 16, hd) dense orientation
+    dense = rows.transpose(1, 0, 2)[None]
+    np.testing.assert_array_equal(np.asarray(view[:, :, : 2 * ps]), dense)
+    # tail entries gather the (zero) trash page
+    assert not np.any(np.asarray(view[:, :, 2 * ps:]))
+    # flat view is the token-major layout of the same data
+    flat = gather_kv_flat(pool_arr, pt)
+    np.testing.assert_array_equal(
+        np.asarray(flat), np.asarray(view.transpose(0, 2, 1, 3))
+    )
+
+
+def test_token_write_lands_in_page_slot_and_trash_for_inactive():
+    ps, hkv, hd = 4, 2, 8
+    pool_arr = jnp.zeros((8, ps, hkv, hd), jnp.float32)
+    pt = page_table_array([[2, 4], [6, 7]], pages_per_seq=2)
+    new = _rand(1, (2, hkv, 1, hd))
+    # slot 0 at length 5 -> logical page 1 (phys 4), slot offset 1;
+    # slot 1 inactive -> its row must NOT land anywhere visible
+    out = write_token_kv(
+        pool_arr, new, pt,
+        jnp.asarray([5, 2], jnp.int32),
+        jnp.asarray([True, False]),
+    )
+    np.testing.assert_array_equal(np.asarray(out[4, 1]), np.asarray(new[0, :, 0]))
+    # only the trash page and the target slot changed
+    changed = np.flatnonzero(
+        np.asarray(jnp.any(out != pool_arr, axis=(1, 2, 3)))
+    )
+    assert set(changed) <= {TRASH_PAGE, 4}
+
+
+def test_page_table_array_rejects_overflow():
+    with pytest.raises(ValueError, match="pages_per_seq"):
+        page_table_array([[1, 2, 3]], pages_per_seq=2)
+
+
+# -- ragged paged attention: bitwise dense parity ---------------------------
+
+@pytest.mark.parametrize("lengths", [[0, 5, 15], [3, 3, 3], [15, 0, 7]])
+def test_paged_attention_bitwise_dense_parity(lengths):
+    from distributed_llm_scheduler_tpu.models.decode import (
+        _decode_attention_natural,
+    )
+    from distributed_llm_scheduler_tpu.ops.attention import (
+        paged_decode_attention,
+    )
+
+    S, Hq, Hkv, hd, ps, ppseq = 3, 4, 2, 8, 4, 4
+    M = ps * ppseq
+    scale = hd ** -0.5
+    rng = np.random.RandomState(0)
+    dense_k = jnp.asarray(rng.randn(S, Hkv, M, hd), jnp.float32)
+    dense_v = jnp.asarray(rng.randn(S, Hkv, M, hd), jnp.float32)
+    q = jnp.asarray(rng.randn(S, Hq, 1, hd), jnp.float32)
+    k_new = jnp.asarray(rng.randn(S, Hkv, 1, hd), jnp.float32)
+    v_new = jnp.asarray(rng.randn(S, Hkv, 1, hd), jnp.float32)
+
+    # scatter each slot's dense rows into disjoint pages
+    pool = PagePool(n_pages=S * ppseq + 1, page_size=ps)
+    tables = [pool.alloc(ppseq) for _ in range(S)]
+    k_pool = jnp.zeros((pool.n_pages, ps, Hkv, hd), jnp.float32)
+    v_pool = jnp.zeros_like(k_pool)
+    for s in range(S):
+        pages = jnp.asarray(tables[s])
+        k_pool = write_prompt_kv(k_pool, dense_k[s].transpose(1, 0, 2), pages)
+        v_pool = write_prompt_kv(v_pool, dense_v[s].transpose(1, 0, 2), pages)
+    pt = page_table_array(tables, ppseq)
+    L = jnp.asarray(lengths, jnp.int32)
+
+    got = paged_decode_attention(
+        q, k_pool, v_pool, pt, L, scale, k_new=k_new, v_new=v_new
+    )
+    # dense oracle: write-then-attend at each slot's own position
+    for s in range(S):
+        k_s = jax.lax.dynamic_update_slice(
+            dense_k[s: s + 1], k_new[s: s + 1], (0, 0, int(lengths[s]), 0)
+        )
+        v_s = jax.lax.dynamic_update_slice(
+            dense_v[s: s + 1], v_new[s: s + 1], (0, 0, int(lengths[s]), 0)
+        )
+        want = _decode_attention_natural(
+            q[s: s + 1], k_s, v_s, jnp.int32(lengths[s]), scale, None, None
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got[s: s + 1]), np.asarray(want),
+            err_msg=f"slot {s} length {lengths[s]} not bitwise equal",
+        )
+
+
+def test_paged_attention_pallas_is_a_seam():
+    from distributed_llm_scheduler_tpu.ops.attention import (
+        paged_decode_attention,
+    )
+
+    z = jnp.zeros((1, 2, 1, 4), jnp.float32)
+    pool = jnp.zeros((2, 4, 2, 4), jnp.float32)
+    pt = jnp.zeros((1, 2), jnp.int32)
+    with pytest.raises(NotImplementedError):
+        paged_decode_attention(
+            z, pool, pool, pt, jnp.zeros((1,), jnp.int32), impl="pallas"
+        )
+
+
+# -- continuous batching engine ---------------------------------------------
+
+def test_paged_loop_rejects_multi_node_placement():
+    from distributed_llm_scheduler_tpu.backends.decode_loop import (
+        compose_paged_step_fn,
+    )
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    dag = build_paged_decode_dag(GPT2Config.tiny(), slots=2, page_size=4,
+                                 n_pages=8, pages_per_seq=4)
+    cluster = Cluster([DeviceState(f"n{i}", 64.0) for i in range(2)])
+    sched = get_scheduler("roundrobin").schedule(dag.graph, cluster)
+    with pytest.raises(ValueError, match="single-node"):
+        compose_paged_step_fn(dag.graph, sched, GPT2Config.tiny())
+
+
+def test_continuous_batching_token_exact_under_churn():
+    """More requests than slots, mixed prompt/gen lengths, so slots
+    retire and readmit mid-run: every request's tokens must equal the
+    whole-program greedy ``generate`` stream, and every page must come
+    back to the pool."""
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    slots, ps, n_pages, ppseq = 2, 8, 32, 4
+    cap = ps * ppseq
+    dag = build_paged_decode_dag(cfg, slots=slots, page_size=ps,
+                                 n_pages=n_pages, pages_per_seq=ppseq)
+    params = dag.init_params()
+    weights = {k: v for k, v in params.items()
+               if not (k.startswith("cache_") or k == "page_table")}
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+    eng = backend.paged_decode_engine(
+        dag.graph, sched, cfg, weights, pool,
+        slots=slots, pages_per_seq=ppseq, seg_steps=4,
+    )
+
+    rng = np.random.RandomState(3)
+    reqs = []
+    for i in range(6):
+        P = [8, 16, 8][i % 3]
+        gen = [10, 5, 1][i % 3]  # gen=1 retires straight from prefill
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, P)), jnp.int32)
+        reqs.append((f"r{i}", ids, gen))
+        eng.submit(f"r{i}", ids, gen)
+    res = eng.run()
+
+    assert set(res) == {rid for rid, _, _ in reqs}
+    for rid, ids, gen in reqs:
+        want = gpt2.generate(params, ids, cfg, max_new_tokens=gen,
+                             max_len=cap)
+        want_new = np.asarray(want)[0, ids.shape[1]:]
+        np.testing.assert_array_equal(
+            res[rid], want_new, err_msg=f"{rid} diverged from generate"
+        )
+    assert pool.free_pages == n_pages - 1, "pages leaked"
+
+    # the engine is reusable: reset returns every page and replays clean
+    eng.reset()
+    eng.submit("again", reqs[0][1], 3)
+    res2 = eng.run()
+    want = gpt2.generate(params, reqs[0][1], cfg, max_new_tokens=3,
+                         max_len=cap)
+    np.testing.assert_array_equal(
+        res2["again"], np.asarray(want)[0, reqs[0][1].shape[1]:]
+    )
+
+
+def test_engine_rejects_oversized_request():
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config.tiny()
+    dag = build_paged_decode_dag(cfg, slots=2, page_size=4, n_pages=8,
+                                 pages_per_seq=2)  # capacity 8
+    params = dag.init_params()
+    weights = {k: v for k, v in params.items()
+               if not (k.startswith("cache_") or k == "page_table")}
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    eng = DeviceBackend(cluster).paged_decode_engine(
+        dag.graph, sched, cfg, weights,
+        PagePool(n_pages=8, page_size=4), slots=2, pages_per_seq=2,
+    )
+    ids = jnp.zeros((1, 6), jnp.int32)
+    with pytest.raises(ValueError, match="capacity"):
+        eng.submit("big", ids, 3)  # 6 + 3 > 8
