@@ -563,6 +563,14 @@ def measure(
         dispatch_overhead_ms=dispatch_overhead_ms,
         model_tag=model_tag,
     )
+    # DLS_TRACE=1: the whole bench recorded into the ambient registry
+    # (transfer bytes per edge, jit-cache hits, overhead histograms);
+    # attach its snapshot to the artifact line
+    from distributed_llm_scheduler_tpu.obs import ambient_metrics
+
+    _amb = ambient_metrics()
+    if _amb is not None:
+        result.metrics = _amb.snapshot()
     log(f"bench: best={best_name} ({best*1e3:.3f} ms) vs roundrobin "
         f"({rr*1e3:.3f} ms) -> {result.vs_baseline:.3f}x; "
         f"total bench {time.time()-t_start:.1f}s")

@@ -10,6 +10,8 @@ Replaces the reference's four bare ``python <file>.py`` entry points
 * ``train``     — a few sharded (dp x tp) training steps
 * ``generate``  — autoregressive KV-cache decoding (any model family)
 * ``bench``     — the north-star benchmark (one JSON line)
+* ``trace``     — traced execute (+ paged-decode leg) -> Perfetto JSON
+* ``metrics``   — same run, metrics-registry snapshot JSON
 """
 
 from __future__ import annotations
@@ -108,12 +110,13 @@ def _load_pretrained_weights(path: str, config, model_name: str):
     return params
 
 
-def _export_trace(schedule, path: str) -> int:
-    """Shared --trace export: 0 on success, 2 (with stderr) on failure."""
+def _export_trace(schedule, path: str, graph=None) -> int:
+    """Shared --trace export: 0 on success, 2 (with stderr) on failure.
+    ``graph`` adds cross-device transfer-edge flow arrows."""
     from .utils.profiling import export_chrome_trace
 
     try:
-        print("trace ->", export_chrome_trace(schedule, path),
+        print("trace ->", export_chrome_trace(schedule, path, graph=graph),
               file=sys.stderr)
         return 0
     except ValueError as e:  # degenerate replay with no timed tasks
@@ -158,7 +161,7 @@ def cmd_schedule(args) -> int:
         "cache_hit_rate": rep.cache_hit_rate,
         "load_balance": rep.load_balance_score,
     }, indent=1, default=str))
-    if args.trace and _export_trace(schedule, args.trace):
+    if args.trace and _export_trace(schedule, args.trace, graph=graph):
         return 2
     if args.save:
         print("graph ->", save_graph(graph, f"{cfg.out_dir}/{graph.name}.graph.json"))
@@ -339,8 +342,21 @@ def cmd_execute(args) -> int:
             return 1
     else:
         print(json.dumps(summary, indent=1, default=str))
-    if args.trace and _export_trace(schedule, args.trace):
+    if args.trace and _export_trace(schedule, args.trace, graph=dag.graph):
         return 2
+    from .obs import ambient_tracer, trace_enabled
+
+    if trace_enabled():
+        # DLS_TRACE=1: the run recorded into the ambient tracer with no
+        # flags; export its unified timeline next to the other artifacts
+        amb = ambient_tracer()
+        if amb is not None and len(amb):
+            from .obs.export import export_perfetto
+
+            os.makedirs(cfg.out_dir, exist_ok=True)
+            print("ambient trace ->", export_perfetto(
+                amb, f"{cfg.out_dir}/execute.trace.json"
+            ), file=sys.stderr)
     return 0
 
 
@@ -1016,6 +1032,110 @@ def cmd_rankcheck(args) -> int:
     return 0 if report["winner_agreement"] else 1
 
 
+def _observed_run(args, tracer, metrics) -> int:
+    """Shared ``trace``/``metrics`` runner: one observed
+    ``DeviceBackend.execute`` of the model DAG on the live mesh, plus
+    (gpt2 family, unless --skip-decode) a small paged continuous-batching
+    decode leg so the decode counter tracks (queue depth, page-pool
+    occupancy) and TTFT/TPOT histograms populate.  0, or 2 when the
+    configuration cannot run."""
+    from .backends.device import DeviceBackend
+
+    cfg = _config_from(args)
+    dag = cfg.build_graph()
+    if not hasattr(dag, "graph"):
+        print("trace/metrics need a model DAG (gpt2* / llama* / mixtral*); "
+              "synthetic graphs have no fns", file=sys.stderr)
+        return 2
+    cluster = cfg.build_cluster_with_devices()
+    schedule = cfg.build_scheduler().schedule(dag.graph, cluster)
+    backend = DeviceBackend(cluster)
+    backend.execute(
+        dag.graph, schedule, dag.init_params(), dag.make_inputs(),
+        trace=tracer, metrics=metrics,
+    )
+    if getattr(args, "skip_decode", False):
+        return 0
+    if _weights_family(cfg.model) != "gpt2":
+        print("decode leg skipped: paged decode is gpt2-family only "
+              "(the execute leg above still traced)", file=sys.stderr)
+        return 0
+    import jax
+    import jax.numpy as jnp
+
+    from .core.cluster import Cluster
+    from .frontend.decode_dag import build_paged_decode_dag
+    from .models.kv_pages import PagePool
+
+    mcfg = cfg.model_config()
+    slots, ps, n_pages, ppseq = 2, 8, 32, 4
+    ddag = build_paged_decode_dag(
+        mcfg, slots=slots, page_size=ps, n_pages=n_pages,
+        pages_per_seq=ppseq,
+    )
+    params = ddag.init_params()
+    weights = {k: v for k, v in params.items()
+               if not (k.startswith("cache_") or k == "page_table")}
+    dcluster = Cluster.from_jax_devices(jax.devices()[:1])
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+    eng = DeviceBackend(dcluster).paged_decode_engine(
+        ddag.graph, cfg.build_scheduler().schedule(ddag.graph, dcluster),
+        mcfg, weights, pool, slots=slots, pages_per_seq=ppseq, seg_steps=4,
+        trace=tracer, metrics=metrics,
+    )
+    # 4 requests over 2 slots: admission waves, retirement churn, and
+    # queue-depth movement — enough to exercise every decode counter
+    for i in range(4):
+        ids = jnp.asarray([[1 + (i % 3), 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        eng.submit(f"r{i}", ids, 6)
+    eng.run()
+    return 0
+
+
+def cmd_trace(args) -> int:
+    from .obs.export import export_perfetto, trace_summary, validate_trace
+    from .obs.metrics import MetricsRegistry
+    from .obs.trace import Tracer
+
+    tracer = Tracer()
+    rc = _observed_run(args, tracer, MetricsRegistry())
+    if rc:
+        return rc
+    if not len(tracer):
+        print("trace: no events recorded", file=sys.stderr)
+        return 2
+    path = export_perfetto(tracer, args.out)
+    errs = validate_trace(path)
+    if errs:
+        for e in errs[:10]:
+            print(f"trace: {e}", file=sys.stderr)
+        return 2
+    print("trace ->", path, file=sys.stderr)
+    print(json.dumps(trace_summary(path), indent=1))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    from .obs.metrics import MetricsRegistry, validate_snapshot
+
+    reg = MetricsRegistry()
+    rc = _observed_run(args, None, reg)
+    if rc:
+        return rc
+    snap = reg.snapshot()
+    errs = validate_snapshot(snap)
+    if errs:
+        for e in errs[:10]:
+            print(f"metrics: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(snap, f, indent=1)
+        print("metrics ->", args.out, file=sys.stderr)
+    print(json.dumps(snap, indent=1))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import importlib.util
     import os
@@ -1200,6 +1320,31 @@ def main(argv=None) -> int:
                    help="bench config: GPT-2 small (flagship, default) or "
                         "medium (BASELINE config #2)")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "trace",
+        help="run an observed execute (+ small paged-decode leg) and "
+             "write one Perfetto-loadable trace JSON",
+    )
+    _add_common(p)
+    p.add_argument("--out", default="trace.json",
+                   help="output trace path (open at ui.perfetto.dev)")
+    p.add_argument("--skip-decode", action="store_true", dest="skip_decode",
+                   help="skip the paged continuous-batching decode leg "
+                        "(its counter tracks and TTFT/TPOT samples)")
+    p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "metrics",
+        help="same observed run, print the metrics-registry snapshot "
+             "(dls.metrics/1 JSON)",
+    )
+    _add_common(p)
+    p.add_argument("--out", default=None,
+                   help="also write the snapshot JSON to this path")
+    p.add_argument("--skip-decode", action="store_true", dest="skip_decode",
+                   help="skip the paged decode leg")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser(
         "rankcheck",

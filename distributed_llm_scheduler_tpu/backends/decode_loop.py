@@ -24,6 +24,7 @@ Reference anchor: the scheduler-owns-inference story is this repo's own
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -330,11 +331,15 @@ class PagedDecodeEngine:
         slots: int,
         pages_per_seq: int,
         seg_steps: int = 8,
+        tracer: Any = None,
+        metrics: Any = None,
+        clock: Any = None,
     ):
         import numpy as np
 
         from ..frontend.decode_dag import cache_dims as _cd
         from ..models.kv_pages import TRASH_PAGE, init_paged_kv
+        from ..obs import MetricsRegistry, ambient_metrics, ambient_tracer
 
         self.config = config
         self.weights = weights
@@ -373,6 +378,21 @@ class PagedDecodeEngine:
         self.results: Dict[Any, Any] = {}
         self._prefill_cache: Dict[int, Any] = {}
         self.segments_run = 0
+        # obs: the tracer is optional (ambient under DLS_TRACE, else off);
+        # the registry always exists so benches can snapshot per-engine
+        # TTFT/TPOT/occupancy unconditionally — recording happens only at
+        # segment boundaries (host side), never inside the scanned program
+        self.tracer = tracer if tracer is not None else ambient_tracer()
+        self.metrics = (
+            metrics if metrics is not None
+            else (ambient_metrics() or MetricsRegistry())
+        )
+        # injectable clock (tests script TTFT/TPOT deterministically);
+        # reads happen between dispatches, so the default perf_counter
+        # shares the host tracer's timebase
+        self._clock = clock if clock is not None else time.perf_counter
+        self._submit_t: Dict[Any, float] = {}     # rid -> submit() time
+        self._first_tok_t: Dict[Any, float] = {}  # rid -> first-token time
 
     def reset(self) -> None:
         """Fresh pool/table/queue state, compiled programs kept.
@@ -404,6 +424,8 @@ class PagedDecodeEngine:
         self._tokens = {}
         self.results = {}
         self.segments_run = 0
+        self._submit_t = {}
+        self._first_tok_t = {}
 
     # -- request intake ----------------------------------------------------
     def submit(self, rid: Any, prompt_ids: Any, max_new_tokens: int) -> None:
@@ -422,6 +444,10 @@ class PagedDecodeEngine:
                 f"{self.page_size})"
             )
         self._queue.append((rid, prompt_ids, max_new_tokens))
+        self._submit_t[rid] = self._clock()
+        self.metrics.counter("decode.requests_submitted").inc()
+        if self.tracer is not None:
+            self.tracer.counter("decode.queue_depth", len(self._queue))
 
     # -- prefill + page scatter (ONE call per admission ROUND; one
     # compiled class per (prompt length, batch size)) ----------------------
@@ -504,6 +530,12 @@ class PagedDecodeEngine:
             if not batch:
                 break  # backpressure: head waits for frees
             del self._queue[:len(batch)]
+            ev_wave = None
+            if self.tracer is not None:
+                ev_wave = self.tracer.begin(
+                    "admission_wave", track="decode", cat="decode",
+                    requests=len(batch), prompt_len=P,
+                )
             pt_rows = self._np.full(
                 (len(batch), self.pages_per_seq), TRASH_PAGE, self._np.int32
             )
@@ -512,11 +544,21 @@ class PagedDecodeEngine:
                 pages = self.pool.alloc(need)
                 page_lists.append(pages)
                 pt_rows[j, :need] = pages
+            t_pf0 = self._clock() if self.tracer is not None else 0.0
             first = self._prefill_scatter(
                 jnp.concatenate([ids for _, ids, _, _ in batch], axis=0),
                 pt_rows,
             )
             first = self._np.asarray(first)
+            # first token exists NOW (the prefill's readback): the
+            # admission timestamp is each request's TTFT anchor
+            t_adm = self._clock()
+            if self.tracer is not None:
+                self.tracer.complete(
+                    "prefill", t_pf0, t_adm, track="decode", cat="decode",
+                    requests=len(batch), prompt_len=P,
+                )
+            ttft_h = self.metrics.histogram("decode.ttft_s", unit="s")
             for j, (rid, ids, max_new, _) in enumerate(batch):
                 s = free_slots[j]
                 self.page_table[s] = pt_rows[j]
@@ -526,9 +568,26 @@ class PagedDecodeEngine:
                 self._slot_req[s] = rid
                 self._slot_pages[s] = page_lists[j]
                 self._tokens[rid] = [int(first[j])]
+                self._first_tok_t[rid] = t_adm
+                sub_t = self._submit_t.pop(rid, None)
+                if sub_t is not None:
+                    ttft_h.observe(t_adm - sub_t)
                 if max_new == 1:  # prefill produced the only token
                     self._retire(s)
             admitted += len(batch)
+            self.metrics.counter("decode.admission_waves").inc()
+            if ev_wave is not None:
+                self.tracer.end(ev_wave)
+                self.tracer.counter("decode.queue_depth", len(self._queue))
+                self.tracer.counter(
+                    "decode.page_pool_occupancy_pages", self.pool.used_pages
+                )
+        if admitted:
+            occ = self.metrics.gauge(
+                "decode.page_pool_occupancy_pages", unit="pages"
+            )
+            occ.set(self.pool.used_pages)
+            self.metrics.gauge("decode.queue_depth").set(len(self._queue))
         return admitted
 
     def _retire(self, s: int) -> None:
@@ -539,6 +598,21 @@ class PagedDecodeEngine:
         )
         self._slot_req[s] = None
         self._slot_pages[s] = []
+        self.metrics.counter("decode.requests_completed").inc()
+        # TPOT = steady-state inter-token gap: last token's arrival (this
+        # retire happens at the segment fold that produced it) minus the
+        # first token's, over n-1 gaps; single-token requests have none
+        n = len(self.results[rid])
+        t_first = self._first_tok_t.pop(rid, None)
+        if t_first is not None and n > 1:
+            self.metrics.histogram("decode.tpot_s", unit="s").observe(
+                (self._clock() - t_first) / (n - 1)
+            )
+        if self.tracer is not None:
+            self.tracer.instant(
+                "retire", track="decode", cat="decode", rid=str(rid),
+                tokens=n,
+            )
 
     # -- the serving loop --------------------------------------------------
     def step_segment(self) -> int:
@@ -548,11 +622,18 @@ class PagedDecodeEngine:
         owed = self.remaining.copy()
         if not owed.any():
             return 0
+        t_sg0 = self._clock() if self.tracer is not None else 0.0
         toks, self.pools = self._seg(
             self.pools, self.page_table, self.lengths,
             self.cur_tok, self.remaining,
         )
         toks = self._np.asarray(toks)  # the one readback per segment
+        if self.tracer is not None:
+            self.tracer.complete(
+                "segment", t_sg0, self._clock(), track="decode",
+                cat="decode", steps=self.seg_steps,
+                active=int((owed > 0).sum()),
+            )
         # slot state advances host-side: each slot ran min(owed, K)
         # active steps, its current token is the last one it emitted
         ran = self._np.minimum(owed, self.seg_steps)
@@ -571,6 +652,17 @@ class PagedDecodeEngine:
             if owed[s] <= self.seg_steps:
                 self._retire(s)
         self.segments_run += 1
+        self.metrics.counter("decode.segments_run").inc()
+        self.metrics.counter("decode.tokens_delivered").inc(delivered)
+        self.metrics.gauge(
+            "decode.page_pool_occupancy_pages", unit="pages"
+        ).set(self.pool.used_pages)
+        self.metrics.gauge("decode.queue_depth").set(len(self._queue))
+        if self.tracer is not None:
+            self.tracer.counter(
+                "decode.page_pool_occupancy_pages", self.pool.used_pages
+            )
+            self.tracer.counter("decode.queue_depth", len(self._queue))
         return delivered
 
     def run(self) -> Dict[Any, Any]:
@@ -587,4 +679,9 @@ class PagedDecodeEngine:
                     "engine stalled: queued requests cannot be admitted "
                     f"({self.pool.free_pages} free pages)"
                 )
+        # every retire returned its pages, so this is 0 on a clean drain —
+        # a nonzero value in a snapshot IS the leak check failing
+        self.metrics.gauge("decode.pages_leaked", unit="pages").set(
+            (self.pool.n_pages - 1) - self.pool.free_pages
+        )
         return self.results

@@ -186,6 +186,11 @@ class DeviceBackend:
         self._group_cache: "weakref.WeakKeyDictionary" = (
             weakref.WeakKeyDictionary()
         )
+        # cumulative jit-cache hit/miss counts across every cache above;
+        # execute() reports the per-call delta into the metrics registry
+        # (obs) as compile.jit_cache_{hits,misses}
+        self.jit_cache_hits = 0
+        self.jit_cache_misses = 0
 
     def _fence_device(self):
         """The device the end-of-run fence reads back from."""
@@ -538,13 +543,19 @@ class DeviceBackend:
             key = (task.fn, donate_argnums)
             fn = self._donate_jit_cache.get(key)
             if fn is None:
+                self.jit_cache_misses += 1
                 fn = jax.jit(task.fn, donate_argnums=donate_argnums)
                 self._donate_jit_cache[key] = fn
+            else:
+                self.jit_cache_hits += 1
             return fn
         fn = self._jit_cache.get(task.fn)
         if fn is None:
+            self.jit_cache_misses += 1
             fn = jax.jit(task.fn)
             self._jit_cache[task.fn] = fn
+        else:
+            self.jit_cache_hits += 1
         return fn
 
     def _grouped_jitted(
@@ -565,11 +576,14 @@ class DeviceBackend:
         if fn is None:
             from .dispatch_plan import _build_group_fn
 
+            self.jit_cache_misses += 1
             fn = jax.jit(
                 _build_group_fn(graph, tids, exports),
                 donate_argnums=donate_argnums or None,
             )
             per_graph[key] = fn
+        else:
+            self.jit_cache_hits += 1
         return fn
 
     def warmup(
@@ -878,6 +892,8 @@ class DeviceBackend:
             List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]]
         ] = None,
         order: Optional[List[str]] = None,
+        tracer: Any = None,
+        metrics: Any = None,
     ) -> Tuple[
         Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any],
         Dict[str, float],
@@ -942,6 +958,12 @@ class DeviceBackend:
         outputs: Dict[str, Any] = dict(ext_outputs or {})
         transfer_edges = 0
         transfer_bytes = 0
+        # obs: one span per fused segment on its device track, flow
+        # arrows for cross-segment transfers (producer export -> consumer
+        # segment); all behind None checks
+        done_at: Optional[Dict[str, Tuple[str, float]]] = (
+            {} if tracer is not None else None
+        )
         t_loop0 = time.perf_counter()
         for seg_i, (node, tids, exports) in enumerate(segments):
             dev = self.cluster[node].jax_device
@@ -950,6 +972,8 @@ class DeviceBackend:
             inside = set(tids)
             needs_input = False
             union_names: Dict[str, None] = {}
+            flow_srcs = [] if tracer is not None else None
+            t_s0 = time.perf_counter() if tracer is not None else 0.0
             for tid in tids:
                 task = graph[tid]
                 for _, g in task.param_items():
@@ -962,8 +986,17 @@ class DeviceBackend:
                         x = outputs[d]
                         if placement.get(d) != node:
                             transfer_edges += 1
-                            transfer_bytes += _array_bytes(x)
+                            nb = _array_bytes(x)
+                            transfer_bytes += nb
                             x = jax.device_put(x, dev)
+                            if tracer is not None:
+                                flow_srcs.append((d, nb))
+                            if metrics is not None:
+                                metrics.counter(
+                                    "transfer.bytes."
+                                    f"{placement.get(d, 'ext')}->{node}",
+                                    unit="bytes",
+                                ).inc(nb)
                         ext[d] = x
             if streamer is not None:
                 union = streamer.get_task(
@@ -978,6 +1011,21 @@ class DeviceBackend:
                 ext["__input__"] = jax.device_put(graph_input, dev)
             fn = self._segment_callable(graph, tids, exports, rebatch)
             seg_out = fn(union, ext)
+            if tracer is not None:
+                t_s1 = time.perf_counter()
+                tracer.complete(
+                    f"seg{seg_i}", t_s0, t_s1, track=node, cat="launch",
+                    tasks=len(tids), exports=len(exports),
+                )
+                for e in exports:
+                    done_at[e] = (node, t_s1)
+                for d, nb in flow_srcs:
+                    src_pt = done_at.get(d)
+                    if src_pt is not None:
+                        tracer.flow(
+                            "transfer", src_pt[0], src_pt[1], node, t_s0,
+                            src=d, dst=f"seg{seg_i}", bytes=nb,
+                        )
             outputs.update(seg_out)
             if streamer is not None and exports:
                 streamer.note_task(
@@ -993,7 +1041,15 @@ class DeviceBackend:
         # guard on executed segments, not `outputs` — ext_outputs seeds can
         # make `outputs` non-empty when nothing actually ran
         if last_on_device and fence:
+            if tracer is not None:
+                t_f0 = time.perf_counter()
             n_fences = self._fence_run(last_on_device)
+            if tracer is not None:
+                tracer.complete(
+                    "fence", t_f0, time.perf_counter(),
+                    track="host", cat="collect",
+                    devices=len(last_on_device),
+                )
         # same semantics as the per-task path: None when the graph's last
         # task didn't execute (callers detect incomplete runs by this)
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
@@ -1018,11 +1074,19 @@ class DeviceBackend:
         streamer: Optional["DeviceBackend._ParamStreamer"] = None,
         fence: bool = True,
         order: Optional[List[str]] = None,
+        tracer: Any = None,
+        metrics: Any = None,
     ) -> Tuple[
         Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any],
         Dict[str, float],
     ]:
         placement = schedule.placement
+        # obs: per-task spans on the device's track (profile timestamps
+        # when available, host dispatch windows otherwise) and transfer
+        # flow arrows; all behind None checks — disabled runs unchanged
+        done_at: Optional[Dict[str, Tuple[str, float]]] = (
+            {} if tracer is not None else None
+        )
         # ext_outputs seed the value table: surviving outputs of an earlier
         # (partial) run whose producers are not in this graph — the elastic
         # recovery path (sched/elastic.py).  They count as transfers when
@@ -1061,6 +1125,7 @@ class DeviceBackend:
                     for loc, glob in task.param_items()
                 }
 
+            flow_srcs = [] if tracer is not None else None
             if arg_ids:
                 args = []
                 for d in arg_ids:
@@ -1068,8 +1133,17 @@ class DeviceBackend:
                     if placement.get(d) != node_id:
                         # cross-core edge: physical transfer (ICI on TPU)
                         transfer_edges += 1
-                        transfer_bytes += _array_bytes(x)
+                        nb = _array_bytes(x)
+                        transfer_bytes += nb
                         x = jax.device_put(x, dev)
+                        if tracer is not None:
+                            flow_srcs.append((d, nb))
+                        if metrics is not None:
+                            metrics.counter(
+                                "transfer.bytes."
+                                f"{placement.get(d, 'ext')}->{node_id}",
+                                unit="bytes",
+                            ).inc(nb)
                     args.append(x)
             else:
                 inp = input_on.get(node_id)
@@ -1088,7 +1162,26 @@ class DeviceBackend:
                     tid, node_id, t0 - t_start, t1 - t_start
                 )
             else:
+                if tracer is not None:
+                    t0 = time.perf_counter()
                 out = fn(pd, *args)
+                if tracer is not None:
+                    t1 = time.perf_counter()
+            if tracer is not None:
+                # profile mode: span == measured task wall; otherwise the
+                # host dispatch window (launch returns at enqueue)
+                tracer.complete(
+                    tid, t0, t1, track=node_id,
+                    cat="task" if profile else "launch",
+                )
+                done_at[tid] = (node_id, t1)
+                for d, nb in flow_srcs:
+                    src_pt = done_at.get(d)
+                    if src_pt is not None:
+                        tracer.flow(
+                            "transfer", src_pt[0], src_pt[1], node_id, t0,
+                            src=d, dst=tid, bytes=nb,
+                        )
             outputs[tid] = out
             if streamer is not None:
                 streamer.note_task(
@@ -1110,7 +1203,15 @@ class DeviceBackend:
             for tid in order:
                 if tid in outputs:
                     last_on_device[placement[tid]] = outputs[tid]
+            if tracer is not None:
+                t_f0 = time.perf_counter()
             n_fences = self._fence_run(last_on_device)
+            if tracer is not None:
+                tracer.complete(
+                    "fence", t_f0, time.perf_counter(),
+                    track="host", cat="collect",
+                    devices=len(last_on_device),
+                )
         final = outputs.get(graph.topo_order[-1]) if graph.topo_order else None
         executed = {
             k: v for k, v in outputs.items()
@@ -1131,6 +1232,9 @@ class DeviceBackend:
         slots: int,
         pages_per_seq: int,
         seg_steps: int = 8,
+        trace: Any = None,
+        metrics: Any = None,
+        clock: Any = None,
     ):
         """Continuous-batching paged decode engine over a SCHEDULED paged
         decode-step DAG (``frontend.build_paged_decode_dag``).
@@ -1154,6 +1258,7 @@ class DeviceBackend:
         return PagedDecodeEngine(
             graph, schedule, config, weights, pool,
             slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
+            tracer=trace, metrics=metrics, clock=clock,
         )
 
     def execute(
@@ -1174,6 +1279,8 @@ class DeviceBackend:
         planned: Optional[bool] = None,
         coalesce: bool = False,
         donate: Optional[bool] = None,
+        trace: Any = None,
+        metrics: Any = None,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
@@ -1263,6 +1370,15 @@ class DeviceBackend:
         mode where per-task dispatch overhead would otherwise dominate
         (e.g. hundreds of sub-ms tasks).  Incompatible with ``profile``
         (task boundaries vanish inside the fused programs).
+
+        ``trace`` / ``metrics`` attach an :class:`..obs.trace.Tracer` /
+        :class:`..obs.metrics.MetricsRegistry` to this run: host phase
+        spans (schedule / stage / plan / launch / collect), per-launch
+        device-track spans, transfer flow arrows, per-edge byte counters,
+        jit-cache hit/miss deltas, and makespan/overhead histograms.
+        ``None`` (the default) falls back to the ambient pair when
+        ``DLS_TRACE=1`` is set, else recording is fully disabled (the
+        hot paths guard every record behind a ``None`` check).
         """
         if segments and profile:
             raise ValueError(
@@ -1316,10 +1432,31 @@ class DeviceBackend:
         missing = sorted(graph.unique_params() - set(params))
         if missing:
             raise ValueError(f"params missing for placement: {missing[:5]}")
+        # obs: explicit trace=/metrics= win; else the DLS_TRACE ambient
+        # pair; else None — and every instrumented path below guards on
+        # None, so a disabled run records nothing and pays only the checks
+        from ..obs import ambient_metrics, ambient_tracer
+
+        tracer = trace if trace is not None else ambient_tracer()
+        mreg = metrics if metrics is not None else ambient_metrics()
+        jit_hits0 = self.jit_cache_hits
+        jit_miss0 = self.jit_cache_misses
+        ev_exec = None
+        if tracer is not None:
+            ev_exec = tracer.begin(
+                "execute", cat="schedule", policy=schedule.policy,
+                segments=segments, reps=reps,
+            )
         # one linearization for the stream plan, the segment build, and
         # every rep: dispatch_order is a pure function of (graph,
         # schedule) and costs ~ms on 500-task DAGs
+        t_ph = time.perf_counter() if tracer is not None else 0.0
         order_once = self.dispatch_order(graph, schedule)
+        if tracer is not None:
+            tracer.complete(
+                "dispatch_order", t_ph, time.perf_counter(),
+                track="host", cat="schedule", tasks=len(order_once),
+            )
         segments_pre = None
         if stream_params:
             placed, bytes_per_node = {}, {d.node_id: 0 for d in self.cluster}
@@ -1351,7 +1488,14 @@ class DeviceBackend:
                         (tid, tuple(g for _, g in graph[tid].param_items()))
                     )
         else:
+            t_ph = time.perf_counter() if tracer is not None else 0.0
             placed, bytes_per_node = self.place_params(graph, schedule, params)
+            if tracer is not None:
+                tracer.complete(
+                    "place_params", t_ph, time.perf_counter(),
+                    track="host", cat="stage",
+                    bytes=sum(bytes_per_node.values()),
+                )
         if segments and segments_pre is None:
             # plain segmented runs were rebuilding segments inside every
             # timed rep (the same host-work-in-makespan bias the order
@@ -1367,15 +1511,22 @@ class DeviceBackend:
         if planned:
             from .dispatch_plan import DispatchPlan
 
+            t_ph = time.perf_counter() if tracer is not None else 0.0
             plan = DispatchPlan.build(
                 self, graph, schedule, order_once, placed,
                 ext_keys=tuple(ext_outputs or ()),
                 donate=donate, coalesce=coalesce,
                 keep_outputs=keep_outputs,
             )
+            if tracer is not None:
+                tracer.complete(
+                    "plan_build", t_ph, time.perf_counter(),
+                    track="host", cat="plan", steps=len(plan.steps),
+                )
 
         compile_s = 0.0
         if warmup:
+            t_ph = time.perf_counter() if tracer is not None else 0.0
             if plan is not None:
                 # one full planned execution: jits every resolved
                 # executable (donating variants and coalesced groups
@@ -1408,6 +1559,14 @@ class DeviceBackend:
                     rebatch=rebatch,
                     segments_pre=segments_pre,
                 )
+            if tracer is not None:
+                # warmup runs untraced (its transfers/launches are compile
+                # artifacts, not steady-state behavior); one host span
+                # covers the whole compile window
+                tracer.complete(
+                    "warmup", t_ph, time.perf_counter(),
+                    track="host", cat="plan", compile_s=compile_s,
+                )
 
         # fence round-trip, re-measured per execute (outside the timed
         # region): tunnel RTT demonstrably changes across reconnects, so a
@@ -1429,11 +1588,15 @@ class DeviceBackend:
         phases_total: Dict[str, float] = {}
         for r in range(reps):
             fence = r == reps - 1  # intermediate reps queue without fencing
+            t_ph = time.perf_counter() if tracer is not None else 0.0
             if plan is not None:
                 (
                     output, timings, tedges, tbytes, n_fences, n_disp,
                     touts, phases,
-                ) = plan.run(graph_input, ext_outputs, fence=fence)
+                ) = plan.run(
+                    graph_input, ext_outputs, fence=fence,
+                    tracer=tracer, metrics=mreg,
+                )
             elif segments:
                 (
                     output, timings, tedges, tbytes, n_fences, n_disp,
@@ -1442,6 +1605,7 @@ class DeviceBackend:
                     graph, schedule, placed, graph_input, ext_outputs,
                     fence=fence, rebatch=rebatch, streamer=streamer,
                     segments_pre=segments_pre, order=order_once,
+                    tracer=tracer, metrics=mreg,
                 )
             else:
                 (
@@ -1450,10 +1614,17 @@ class DeviceBackend:
                 ) = self._run(
                     graph, schedule, placed, graph_input, profile,
                     ext_outputs, streamer, fence=fence, order=order_once,
+                    tracer=tracer, metrics=mreg,
                 )
             loop_s_total += phases.get("loop_s", 0.0)
             for k, v in phases.items():
                 phases_total[k] = phases_total.get(k, 0.0) + v
+            if tracer is not None:
+                tracer.complete(
+                    f"rep{r}", t_ph, time.perf_counter(),
+                    track="host", cat="launch",
+                    dispatches=n_disp, fenced=fence,
+                )
         wall = time.perf_counter() - t0
         makespan = max((wall - n_fences * rtt) / reps, 1e-9)
         dispatch_overhead_s = loop_s_total / reps
@@ -1470,6 +1641,38 @@ class DeviceBackend:
 
         if timings:
             schedule.timings = timings
+        if mreg is not None:
+            # per-rep counts are identical across reps, so the run totals
+            # are a clean multiply; histograms get one sample per execute
+            mreg.counter("dispatch.launches").inc(n_disp * reps)
+            mreg.counter("dispatch.transfer_edges").inc(tedges * reps)
+            mreg.counter("dispatch.transfer_bytes", unit="bytes").inc(
+                tbytes * reps
+            )
+            mreg.histogram("dispatch.overhead_s", unit="s").observe(
+                dispatch_overhead_s
+            )
+            mreg.histogram("execute.makespan_s", unit="s").observe(makespan)
+            mreg.histogram("execute.compile_s", unit="s").observe(compile_s)
+            mreg.counter("compile.jit_cache_hits").inc(
+                self.jit_cache_hits - jit_hits0
+            )
+            mreg.counter("compile.jit_cache_misses").inc(
+                self.jit_cache_misses - jit_miss0
+            )
+            if timings:
+                # profile mode: busy fraction per device over the measured
+                # span — the Gantt chart's utilization column as a gauge
+                span_end = max(t.finish for t in timings.values())
+                busy: Dict[str, float] = {}
+                for t in timings.values():
+                    busy[t.node_id] = busy.get(t.node_id, 0.0) + t.duration
+                for n, b in busy.items():
+                    mreg.gauge(f"device.utilization.{n}", unit="frac").set(
+                        b / span_end if span_end > 0 else 0.0
+                    )
+        if ev_exec is not None:
+            tracer.end(ev_exec, makespan_s=makespan)
         return DeviceReport(
             policy=schedule.policy,
             makespan_s=makespan,

@@ -270,6 +270,8 @@ class PlanStep:
         "xfer_slots",    # unique slots needing device_put onto `dev`
         "get_srcs",      # itemgetter over xfer_slots
         "xfer_map",      # (arg position, index into xfer_slots) pairs
+        "xfer_src_tids",  # producer id per xfer slot (tracing: flow arrows)
+        "xfer_src_nodes",  # producer node per xfer slot ("ext" for seeds)
         "xfer_shard",    # SingleDeviceSharding(dev) for the fast put path
         "xfer_devs",     # [dev] for the fast put path
         "xfer_avals",    # per-xfer_slots avals, filled on first run;
@@ -434,6 +436,7 @@ class DispatchPlan:
             )
 
             xfer_slots: List[int] = []
+            xfer_srcs: List[str] = []  # producer per unique slot (tracing)
             xfer_map: List[Tuple[int, int]] = []
             xfer_ext: set = set()  # xfer indices sourced from ext values
             for pos, d in enumerate(ext_list):
@@ -447,6 +450,7 @@ class DispatchPlan:
                 else:
                     ui = len(xfer_slots)
                     xfer_slots.append(s)
+                    xfer_srcs.append(d)
                 xfer_map.append((pos, ui))
                 if d not in placement:
                     xfer_ext.add(ui)
@@ -490,6 +494,10 @@ class DispatchPlan:
             step.xfer_slots = tuple(xfer_slots)
             step.get_srcs = _tuple_getter(step.xfer_slots)
             step.xfer_map = tuple(xfer_map)
+            step.xfer_src_tids = tuple(xfer_srcs)
+            step.xfer_src_nodes = tuple(
+                placement.get(d, "ext") for d in xfer_srcs
+            )
             step.xfer_shard = SingleDeviceSharding(dev) if xfer_slots else None
             step.xfer_devs = [dev]
             step.xfer_avals = None
@@ -565,14 +573,28 @@ class DispatchPlan:
         graph_input: Any,
         ext_outputs: Optional[Dict[str, Any]] = None,
         fence: bool = True,
+        tracer: Any = None,
+        metrics: Any = None,
     ) -> Tuple[Any, Dict, int, int, int, int, Dict[str, Any], Dict[str, float]]:
         """Execute the plan once.  Same return contract as the legacy
         runners plus a phase dict: ``(final, timings, transfer_edges,
         transfer_bytes, n_fences, n_dispatches, executed, phases)`` with
         ``phases = {loop_s, stage_s, launch_s}`` — host wall inside the
         dispatch loop (fence excluded), split into staging (input placement
-        + batched transfers) and launch (executable calls)."""
+        + batched transfers) and launch (executable calls).
+
+        ``tracer`` (obs.trace.Tracer, optional): records one launch span
+        per step on the step's device track, staging spans, and transfer
+        flow arrows from producer launches.  ``metrics`` (obs.metrics.
+        MetricsRegistry, optional): per-(src->dst) transfer byte counters.
+        Both default to None and every instrumentation point is behind a
+        None check — the disabled hot loop is the PR 2 fast path
+        unchanged (the <2% regression budget is measured by
+        ``eval/dispatch_bench.py``)."""
         vals: List[Any] = [None] * self.n_slots
+        done: Optional[Dict[str, Tuple[str, float]]] = (
+            {} if tracer is not None else None
+        )
         t_loop0 = time.perf_counter()
         stage_s = 0.0
         if ext_outputs:
@@ -583,9 +605,15 @@ class DispatchPlan:
             for _n, dev, s in self.input_slots:
                 vals[s] = jax.device_put(graph_input, dev)
             stage_s += time.perf_counter() - t0
+            if tracer is not None:
+                tracer.complete(
+                    "stage_input", t0, time.perf_counter(),
+                    track="host", cat="stage", devices=len(self.input_slots),
+                )
 
         tbytes = 0
         for step in self.steps:
+            per_edge = None
             if step.xfer_slots:
                 args = list(step.get_args(vals))
                 srcs = step.get_srcs(vals)
@@ -593,6 +621,8 @@ class DispatchPlan:
                     step.xfer_bytes = sum(
                         _array_bytes(srcs[ui]) for _p, ui in step.xfer_map
                     )
+                if metrics is not None:
+                    per_edge = [_array_bytes(x) for x in srcs]
                 t0 = time.perf_counter()
                 if step.xfer_avals and _fast_put is not None:
                     shard, devs = step.xfer_shard, step.xfer_devs
@@ -612,25 +642,66 @@ class DispatchPlan:
                             if all(hasattr(m, "aval") for m in moved)
                             else False
                         )
-                stage_s += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                stage_s += t1 - t0
+                if tracer is not None:
+                    tracer.complete(
+                        "stage", t0, t1, track=step.node_id, cat="stage",
+                        transfers=len(step.xfer_slots),
+                    )
+                if metrics is not None:
+                    for ui, src_node in enumerate(step.xfer_src_nodes):
+                        metrics.counter(
+                            f"transfer.bytes.{src_node}->{step.node_id}",
+                            unit="bytes",
+                        ).inc(per_edge[ui])
                 for pos, ui in step.xfer_map:
                     args[pos] = moved[ui]
             else:
                 args = step.get_args(vals)
             tbytes += step.xfer_bytes
+            if tracer is not None:
+                t_l0 = time.perf_counter()
             if step.group:
                 outs = step.fn(step.pd, *args)
                 for s, o in zip(step.out_slots, outs):
                     vals[s] = o
             else:
                 vals[step.out_slots[0]] = step.fn(step.pd, *args)
+            if tracer is not None:
+                t_l1 = time.perf_counter()
+                name = (
+                    step.tids[0] if len(step.tids) == 1
+                    else f"{step.tids[0]}+{len(step.tids) - 1}"
+                )
+                tracer.complete(
+                    name, t_l0, t_l1, track=step.node_id, cat="launch",
+                    tasks=len(step.tids), edges=step.n_edges,
+                )
+                for t in step.tids:
+                    done[t] = (step.node_id, t_l1)
+                for ui, src in enumerate(step.xfer_src_tids):
+                    src_pt = done.get(src)
+                    if src_pt is not None:
+                        tracer.flow(
+                            "transfer", src_pt[0], src_pt[1],
+                            step.node_id, t_l0, src=src, dst=step.tids[0],
+                        )
         loop_s = time.perf_counter() - t_loop0
 
         n_fences = 0
         if fence and self.steps:
+            if tracer is not None:
+                t_f0 = time.perf_counter()
             n_fences = self._backend._fence_run(
                 {n: vals[s] for n, s in self.fence_slots}
             )
+            if tracer is not None:
+                tracer.complete(
+                    "fence", t_f0, time.perf_counter(),
+                    track="host", cat="collect",
+                    devices=len(self.fence_slots),
+                )
         final = vals[self.final_slot] if self.final_slot is not None else None
         executed = {t: vals[s] for t, s in self.keep_list}
         return (

@@ -587,6 +587,11 @@ class BenchResult:
     # the absolute number behind the dispatch_overhead ratio
     dispatch_overhead_ms: Optional[float] = None
 
+    # obs (DLS_TRACE=1): the ambient metrics-registry snapshot
+    # (dls.metrics/1 schema) attached to the bench line — transfer bytes
+    # per edge, jit-cache hit rates, dispatch-overhead histograms
+    metrics: Optional[Dict[str, object]] = None
+
     # which model config this line benchmarks: gpt2s (small, the driver's
     # default run) or gpt2m (medium, BASELINE config #2 — a separate
     # ``python bench.py medium`` invocation, artifact committed per round)
@@ -650,6 +655,8 @@ class BenchResult:
             # every measured leg's repeat-capture stats; "quotes" records
             # which estimator the headline quantities use
             out["spread"] = {"quotes": "median", **self.spread}
+        if self.metrics is not None:
+            out["metrics"] = self.metrics
         if self.ici_sensitivity is not None:
             out["ici_sensitivity"] = {
                 k: {

@@ -305,12 +305,23 @@ def main(argv) -> int:
     platform = jax.devices()[0].platform
     log(f"capture: round {round_n}, platform={platform}, legs={wanted}")
     rc = 0
+    from distributed_llm_scheduler_tpu.obs import (
+        ambient_metrics,
+        reset_ambient,
+    )
+
     for w in wanted:
         prefix, fn = LEGS[w]
         t0 = time.time()
+        reset_ambient()  # each leg's ambient snapshot starts clean
         out = _guarded(w, fn)
         out.setdefault("platform", platform)
         out["round"] = round_n
+        # DLS_TRACE=1: attach the leg's ambient metrics snapshot (obs) —
+        # transfer bytes per edge, jit-cache hits, overhead histograms
+        amb = ambient_metrics()
+        if amb is not None:
+            out["obs_metrics"] = amb.snapshot()
         path = os.path.join(REPO_ROOT, f"{prefix}_r{round_n:02d}.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=1)

@@ -1088,6 +1088,9 @@ def measure_paged_decode(
         "paged_tok_s": round(paged_tok_s, 4),
         "speedup": round(paged_tok_s / max(dense_tok_s, 1e-9), 4),
         "tokens_exact": bool(exact),
+        # the engine's own registry (TTFT/TPOT histograms, occupancy
+        # gauges, request/token counters) — always present, obs
+        "metrics": eng.metrics.snapshot(),
     }
 
 

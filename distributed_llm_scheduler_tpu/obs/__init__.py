@@ -1,0 +1,86 @@
+"""Observability: span tracing, metrics, and Perfetto export.
+
+The cross-cutting layer ISSUE 4 adds over the three performance-critical
+subsystems (planned dispatch, segment fusion, paged decode):
+
+* :mod:`.trace` — structured span tracer (nested spans, categories,
+  injectable clock);
+* :mod:`.metrics` — counters/gauges/histograms with a stable JSON
+  snapshot schema;
+* :mod:`.export` — Chrome/Perfetto rendering of either a tracer's
+  unified timeline or a timed schedule.
+
+Everything is opt-in.  Two ways to turn it on:
+
+* **Explicit**: pass ``trace=Tracer()`` / ``metrics=MetricsRegistry()``
+  to ``DeviceBackend.execute`` (or the paged decode engine), then
+  ``export.export_perfetto(tracer, path)``.
+* **Ambient**: set ``DLS_TRACE=1`` and every ``execute``/engine in the
+  process records into one shared tracer + registry
+  (:func:`ambient_tracer` / :func:`ambient_metrics`); benches and
+  ``eval/capture_artifacts.py`` attach the registry snapshot to their
+  artifacts, and the ``execute`` CLI exports the trace on exit.
+
+With the env var unset and no explicit objects passed, the ambient
+getters return ``None`` and instrumented hot paths skip all recording
+(``if tracer is not None`` guards — the disabled path stays within the
+<2% planned-dispatch overhead budget).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .metrics import MetricsRegistry
+from .trace import HOST_TRACK, Tracer
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+_ambient_tracer: Optional[Tracer] = None
+_ambient_metrics: Optional[MetricsRegistry] = None
+
+
+def trace_enabled() -> bool:
+    """True when ``DLS_TRACE`` requests ambient observability."""
+    return os.environ.get("DLS_TRACE", "").strip().lower() in _TRUTHY
+
+
+def ambient_tracer() -> Optional[Tracer]:
+    """The process-wide tracer when ``DLS_TRACE`` is set, else None.
+    Created lazily on first use; one tracer accumulates every run in
+    the process so the export is a single unified timeline."""
+    global _ambient_tracer
+    if not trace_enabled():
+        return None
+    if _ambient_tracer is None:
+        _ambient_tracer = Tracer()
+    return _ambient_tracer
+
+
+def ambient_metrics() -> Optional[MetricsRegistry]:
+    """The process-wide registry when ``DLS_TRACE`` is set, else None."""
+    global _ambient_metrics
+    if not trace_enabled():
+        return None
+    if _ambient_metrics is None:
+        _ambient_metrics = MetricsRegistry()
+    return _ambient_metrics
+
+
+def reset_ambient() -> None:
+    """Drop the ambient tracer/registry (tests; fresh CLI legs)."""
+    global _ambient_tracer, _ambient_metrics
+    _ambient_tracer = None
+    _ambient_metrics = None
+
+
+__all__ = [
+    "HOST_TRACK",
+    "MetricsRegistry",
+    "Tracer",
+    "ambient_metrics",
+    "ambient_tracer",
+    "reset_ambient",
+    "trace_enabled",
+]

@@ -1,0 +1,184 @@
+"""Metrics registry: counters / gauges / histograms with a stable JSON
+snapshot schema.
+
+The numeric complement to the span tracer (:mod:`.trace`): where spans
+answer "when did what run", the registry answers "how much, how often,
+how long" — page-pool occupancy and leak checks, per-request TTFT/TPOT,
+queue depth, dispatch overhead, jit-cache hits, transfer bytes per edge,
+per-device utilization.  Bench artifacts embed ``snapshot()`` verbatim,
+so the snapshot layout is contractual (``tests/test_artifacts_schema.py``
+and ``tests/test_obs.py`` guard it):
+
+```json
+{"schema": "dls.metrics/1",
+ "counters":   {"<name>": {"value": 0, "unit": null}},
+ "gauges":     {"<name>": {"value": 0, "max": 0, "unit": null}},
+ "histograms": {"<name>": {"count": 0, "sum": 0, "min": 0, "max": 0,
+                           "mean": 0, "p50": 0, "p95": 0, "unit": null}}}
+```
+
+Metric names are dotted lowercase (``decode.ttft_s``); the ``_s`` /
+``_bytes`` / ``_pages`` suffix states the unit in the name, and the
+``unit`` field repeats it machine-readably.  The full catalog lives in
+``docs/OBSERVABILITY.md``.
+
+Recording is plain Python arithmetic — cheap enough that the decode
+engine keeps a registry unconditionally (per-segment granularity), while
+the dispatch hot loop records only when observability is on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+SCHEMA = "dls.metrics/1"
+
+# histograms keep at most this many raw samples for the percentile
+# estimate; count/sum/min/max stay exact beyond it (serving-length runs
+# must not grow memory linearly in tokens)
+_HIST_CAP = 4096
+
+
+class Counter:
+    """Monotonic accumulator (events, bytes)."""
+
+    __slots__ = ("value", "unit")
+
+    def __init__(self, unit: Optional[str] = None):
+        self.value: float = 0
+        self.unit = unit
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-value-wins sample with a high-water mark (occupancy, depth)."""
+
+    __slots__ = ("value", "max", "unit")
+
+    def __init__(self, unit: Optional[str] = None):
+        self.value: float = 0
+        self.max: float = 0
+        self.unit = unit
+
+    def set(self, v: float) -> None:
+        self.value = v
+        if v > self.max:
+            self.max = v
+
+
+class Histogram:
+    """Distribution sketch (latencies): exact count/sum/min/max, p50/p95
+    from the first :data:`_HIST_CAP` raw samples."""
+
+    __slots__ = ("count", "sum", "min", "max", "unit", "_samples")
+
+    def __init__(self, unit: Optional[str] = None):
+        self.count = 0
+        self.sum: float = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.unit = unit
+        self._samples: List[float] = []
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        if len(self._samples) < _HIST_CAP:
+            self._samples.append(v)
+
+    def _quantile(self, q: float) -> Optional[float]:
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        return s[min(int(q * len(s)), len(s) - 1)]
+
+
+class MetricsRegistry:
+    """Get-or-create registry; re-requesting a name returns the same
+    instrument (the first declared unit wins)."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def counter(self, name: str, unit: Optional[str] = None) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = Counter(unit)
+        return c
+
+    def gauge(self, name: str, unit: Optional[str] = None) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge(unit)
+        return g
+
+    def histogram(self, name: str, unit: Optional[str] = None) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = Histogram(unit)
+        return h
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Stable JSON-ready view (see module docstring for the schema)."""
+        return {
+            "schema": SCHEMA,
+            "counters": {
+                n: {"value": c.value, "unit": c.unit}
+                for n, c in sorted(self._counters.items())
+            },
+            "gauges": {
+                n: {"value": g.value, "max": g.max, "unit": g.unit}
+                for n, g in sorted(self._gauges.items())
+            },
+            "histograms": {
+                n: {
+                    "count": h.count,
+                    "sum": h.sum,
+                    "min": h.min,
+                    "max": h.max,
+                    "mean": (h.sum / h.count) if h.count else None,
+                    "p50": h._quantile(0.50),
+                    "p95": h._quantile(0.95),
+                    "unit": h.unit,
+                }
+                for n, h in sorted(self._hists.items())
+            },
+        }
+
+
+def validate_snapshot(snap: Any) -> List[str]:
+    """Structural check of a ``snapshot()`` dict; returns human-readable
+    problems (empty list == valid).  Shared by the artifact schema tests
+    and the ``metrics`` CLI."""
+    errs: List[str] = []
+    if not isinstance(snap, dict):
+        return [f"snapshot is {type(snap).__name__}, not dict"]
+    if snap.get("schema") != SCHEMA:
+        errs.append(f"schema is {snap.get('schema')!r}, want {SCHEMA!r}")
+    for family, fields in (
+        ("counters", ("value", "unit")),
+        ("gauges", ("value", "max", "unit")),
+        ("histograms", ("count", "sum", "min", "max", "mean", "p50",
+                        "p95", "unit")),
+    ):
+        block = snap.get(family)
+        if not isinstance(block, dict):
+            errs.append(f"{family} block missing or not a dict")
+            continue
+        for name, row in block.items():
+            if not isinstance(row, dict):
+                errs.append(f"{family}.{name} is not a dict")
+                continue
+            for f in fields:
+                if f not in row:
+                    errs.append(f"{family}.{name} missing {f!r}")
+    return errs

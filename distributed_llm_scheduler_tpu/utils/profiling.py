@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Any, Callable, Dict, Iterator
 
 
@@ -47,74 +48,48 @@ def compiled_cost_analysis(
     compiled = lowered.compile()
     try:
         analysis = compiled.cost_analysis()
-    except Exception:
-        return {}
+    except Exception as e:  # pragma: no cover - backend-dependent
+        # surface WHY, so callers can tell "zero cost" from "analysis
+        # unavailable on this backend" (a silent {} made benches report
+        # 0 FLOPs as if measured)
+        reason = f"{type(e).__name__}: {e}"
+        warnings.warn(f"cost_analysis unavailable: {reason}", stacklevel=2)
+        return {"_error": reason}
     if isinstance(analysis, list):  # per-device list on older APIs
         analysis = analysis[0] if analysis else {}
     return {k: float(v) for k, v in dict(analysis).items()
             if isinstance(v, (int, float))}
 
 
-def export_chrome_trace(schedule: Any, path: str) -> str:
+def export_chrome_trace(schedule: Any, path: str, graph: Any = None) -> str:
     """Write a schedule's task timeline as a Chrome/Perfetto trace JSON.
 
-    Open the file at ``chrome://tracing`` or https://ui.perfetto.dev — one
-    row ("thread") per device, one complete event per task, microsecond
-    units.  Works with any timed schedule: ``DeviceBackend`` profile-mode
-    timings and the simulated backend's replay timings both fill
-    ``Schedule.timings`` (the reference's closest analog is its static
-    Gantt plot, reference ``visu.py:206-248``; this is the interactive
-    equivalent over *measured* timestamps).
+    Delegates to :func:`..obs.export.export_chrome_trace` (the unified
+    exporter, which also renders live :class:`..obs.trace.Tracer`
+    timelines): one row per device, one complete event per task,
+    microsecond units, plus — new — cross-device transfer edges as flow
+    arrows when ``graph`` is given and a ``run_fence`` instant at the
+    makespan point.  Works with any timed schedule: ``DeviceBackend``
+    profile-mode timings and the simulated backend's replay timings both
+    fill ``Schedule.timings``.
 
     Returns ``path``.  Raises ``ValueError`` if the schedule carries no
     timings (execute with ``profile=True`` or replay on the simulated
     backend first).
     """
-    import json as _json
-    import os as _os
+    from ..obs.export import export_chrome_trace as _export
 
-    timings = getattr(schedule, "timings", None) or {}
-    if not timings:
-        raise ValueError(
-            "schedule has no timings; run DeviceBackend.execute("
-            "profile=True) or SimulatedBackend.execute first"
-        )
-    # stable row order: sort devices by id, tasks by start
-    node_ids = sorted({t.node_id for t in timings.values()})
-    tids = {n: i + 1 for i, n in enumerate(node_ids)}
-    events = [
-        {
-            "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
-            "args": {"name": getattr(schedule, "policy", "schedule")},
-        }
-    ]
-    for n in node_ids:
-        events.append({
-            "name": "thread_name", "ph": "M", "pid": 1, "tid": tids[n],
-            "args": {"name": n},
-        })
-    for tt in sorted(timings.values(), key=lambda t: (t.start, t.task_id)):
-        events.append({
-            "name": tt.task_id,
-            "cat": "task",
-            "ph": "X",  # complete event
-            "pid": 1,
-            "tid": tids[tt.node_id],
-            "ts": tt.start * 1e6,
-            "dur": max(tt.duration, 0.0) * 1e6,
-            "args": {"node": tt.node_id},
-        })
-    _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
-        _json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    return path
+    return _export(schedule, path, graph=graph)
 
 
 def time_fn(fn: Callable[..., Any], *args: Any, repeats: int = 5) -> float:
     """Best-of-N wall time of a jitted call (blocks on the result)."""
     import jax
 
-    fn(*args)  # warmup/compile
+    # block on the warmup too: dispatch is async, so an unfenced warmup
+    # call can still be executing when the first timed repeat starts —
+    # that repeat then absorbs leftover warmup work and inflates `best`
+    jax.block_until_ready(fn(*args))  # warmup/compile
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()

@@ -100,6 +100,33 @@ def test_decode_artifact_schema():
         assert paged["tokens_exact"] is True, path
         assert paged["pages_leaked"] == 0, path
         assert paged["speedup"] >= 1.0, path
+        if "metrics" in paged:  # engine metrics snapshot added r7
+            from distributed_llm_scheduler_tpu.obs.metrics import (
+                validate_snapshot,
+            )
+
+            assert validate_snapshot(paged["metrics"]) == [], path
+            counters = paged["metrics"]["counters"]
+            assert "decode.requests_completed" in counters, path
+
+
+def test_artifact_obs_metrics_blocks_validate():
+    """Any artifact leg captured under DLS_TRACE=1 carries an
+    ``obs_metrics`` snapshot (added r7); when present it must satisfy the
+    dls.metrics/1 schema so downstream dashboards can rely on it."""
+    from distributed_llm_scheduler_tpu.obs.metrics import validate_snapshot
+
+    found = 0
+    for path in sorted(glob.glob(os.path.join(ROOT, "*_r*.json"))):
+        d = json.load(open(path))
+        if not isinstance(d, dict):
+            continue
+        for block in (d.get("obs_metrics"), d.get("metrics")):
+            if block is not None:
+                assert validate_snapshot(block) == [], path
+                found += 1
+    if not found:
+        pytest.skip("no committed artifact carries a metrics block yet")
 
 
 def test_train_artifact_schema():

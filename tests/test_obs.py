@@ -1,0 +1,447 @@
+"""Observability layer tests: span tracer, metrics registry, Perfetto
+exporter, schedule-trace extensions, decode TTFT/TPOT, and the
+zero-overhead disabled path."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+from distributed_llm_scheduler_tpu.obs import (
+    ambient_metrics,
+    ambient_tracer,
+    reset_ambient,
+    trace_enabled,
+)
+from distributed_llm_scheduler_tpu.obs.export import (
+    chrome_events,
+    export_perfetto,
+    trace_summary,
+    validate_trace,
+)
+from distributed_llm_scheduler_tpu.obs.metrics import (
+    MetricsRegistry,
+    validate_snapshot,
+)
+from distributed_llm_scheduler_tpu.obs.trace import HOST_TRACK, Tracer
+
+
+class FakeClock:
+    """Deterministic injectable clock: tests set ``.t`` between calls."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+
+
+def test_span_nesting_and_ordering_with_fake_clock():
+    clk = FakeClock(1.0)
+    tr = Tracer(clock=clk)
+    outer = tr.begin("outer", cat="schedule", policy="greedy")
+    clk.t = 2.0
+    inner = tr.begin("inner", track="core_0", cat="launch")
+    clk.t = 3.0
+    tr.end(inner)
+    clk.t = 5.0
+    tr.end(outer, makespan_s=4.0)
+
+    assert len(tr) == 2
+    # inner closes first, so it lands first in the event list
+    first, second = tr.events
+    assert (first["name"], first["t0"], first["t1"]) == ("inner", 2.0, 3.0)
+    assert (second["name"], second["t0"], second["t1"]) == ("outer", 1.0, 5.0)
+    assert second["args"]["policy"] == "greedy"
+    assert second["args"]["makespan_s"] == 4.0
+    # nesting invariant for Perfetto: parent strictly encloses child
+    assert second["t0"] <= first["t0"] and first["t1"] <= second["t1"]
+    assert tr.tracks() == [HOST_TRACK, "core_0"]
+
+
+def test_tracer_span_contextmanager_and_complete():
+    clk = FakeClock(10.0)
+    tr = Tracer(clock=clk)
+    with tr.span("work", track="core_1", cat="task", tid="t1"):
+        clk.t = 12.0
+    tr.complete("seg0", 20.0, 21.5, track="core_1", cat="launch", tasks=3)
+    spans = {e["name"]: e for e in tr.events}
+    assert spans["work"]["t0"] == 10.0 and spans["work"]["t1"] == 12.0
+    assert spans["seg0"]["t0"] == 20.0 and spans["seg0"]["t1"] == 21.5
+    assert spans["seg0"]["args"]["tasks"] == 3
+
+
+def test_tracer_instant_counter_flow():
+    clk = FakeClock(0.5)
+    tr = Tracer(clock=clk)
+    tr.instant("retire", track="decode", cat="decode", rid="r0")
+    tr.counter("decode.queue_depth", 3)
+    clk.t = 0.75
+    tr.counter("decode.queue_depth", 2, t=0.6)
+    tr.flow("transfer", "core_0", 0.5, "core_1", 0.7, bytes=128)
+
+    kinds = [e["type"] for e in tr.events]
+    assert kinds == ["instant", "counter", "counter", "flow"]
+    inst, c1, c2, fl = tr.events
+    assert inst["t"] == 0.5 and inst["args"]["rid"] == "r0"
+    assert c1["value"] == 3 and c2["t"] == 0.6
+    assert fl["src_track"] == "core_0" and fl["dst_track"] == "core_1"
+    assert fl["args"]["bytes"] == 128
+    assert tr.counter_names() == ["decode.queue_depth"]
+    # flow-only tracks still surface via the exporter's tid map
+    evs = chrome_events(tr)
+    names = {e["args"]["name"] for e in evs if e["name"] == "thread_name"}
+    assert {"decode", "core_0", "core_1"} <= names
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+
+
+def test_metrics_snapshot_schema_and_values():
+    reg = MetricsRegistry()
+    reg.counter("dispatch.launches").inc(3)
+    reg.counter("dispatch.launches").inc(2)
+    reg.counter("transfer.bytes", unit="bytes").inc(1024)
+    g = reg.gauge("decode.queue_depth")
+    g.set(5)
+    g.set(2)
+    h = reg.histogram("decode.ttft_s", unit="s")
+    for v in (0.1, 0.2, 0.3, 0.4):
+        h.observe(v)
+
+    snap = reg.snapshot()
+    assert validate_snapshot(snap) == []
+    assert snap["schema"] == "dls.metrics/1"
+    assert snap["counters"]["dispatch.launches"]["value"] == 5
+    assert snap["counters"]["transfer.bytes"]["unit"] == "bytes"
+    # gauge keeps last value plus high-water mark
+    qd = snap["gauges"]["decode.queue_depth"]
+    assert qd["value"] == 2 and qd["max"] == 5
+    ttft = snap["histograms"]["decode.ttft_s"]
+    assert ttft["count"] == 4
+    assert ttft["min"] == 0.1 and ttft["max"] == 0.4
+    assert abs(ttft["mean"] - 0.25) < 1e-12
+    assert ttft["p50"] in (0.2, 0.3)
+    assert ttft["unit"] == "s"
+    # snapshot is JSON-serializable as-is (artifact embedding contract)
+    json.dumps(snap)
+
+
+def test_metrics_get_or_create_is_stable():
+    reg = MetricsRegistry()
+    a = reg.counter("x", unit="bytes")
+    b = reg.counter("x")
+    assert a is b
+    snap = reg.snapshot()
+    assert snap["counters"]["x"]["unit"] == "bytes"
+
+
+def test_validate_snapshot_rejects_malformed():
+    assert validate_snapshot(None) != []
+    assert validate_snapshot({"schema": "bogus/9"}) != []
+    bad = {
+        "schema": "dls.metrics/1",
+        "counters": {"c": {}},  # missing value
+        "gauges": {},
+        "histograms": {"h": {"count": 1}},  # missing stats
+    }
+    errs = validate_snapshot(bad)
+    assert errs and any("c" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# Exporter
+
+
+def _sample_tracer() -> Tracer:
+    clk = FakeClock(100.0)
+    tr = Tracer(clock=clk)
+    ev = tr.begin("execute", cat="schedule")
+    tr.complete("task_a", 100.5, 101.0, track="core_0", cat="task")
+    tr.complete("task_b", 101.2, 101.9, track="core_1", cat="task")
+    tr.flow("transfer", "core_0", 101.0, "core_1", 101.2, bytes=64)
+    tr.instant("fence_done", track=HOST_TRACK, cat="collect", t=102.0)
+    tr.counter("decode.queue_depth", 1, t=100.2)
+    tr.counter("decode.queue_depth", 0, t=101.8)
+    clk.t = 102.5
+    tr.end(ev)
+    return tr
+
+
+def test_chrome_events_structure_and_epoch():
+    evs = chrome_events(_sample_tracer(), process_name="proc")
+    proc = [e for e in evs if e["name"] == "process_name"]
+    assert len(proc) == 1 and proc[0]["args"]["name"] == "proc"
+    rows = [e for e in evs if e["name"] == "thread_name"]
+    row_names = [e["args"]["name"] for e in rows]
+    assert row_names[0] == HOST_TRACK  # host row is always tid 1
+    assert set(row_names) == {HOST_TRACK, "core_0", "core_1"}
+
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    # epoch normalizes to the earliest event: execute began at t=100.0
+    assert xs["execute"]["ts"] == 0
+    assert xs["task_a"]["ts"] == pytest.approx(0.5e6)
+    assert xs["task_a"]["dur"] == pytest.approx(0.5e6)
+    host_tid = rows[0]["tid"]
+    assert xs["execute"]["tid"] == host_tid
+
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert len(counters) == 2
+    assert [c["args"]["value"] for c in counters] == [1, 0]
+
+    starts = [e for e in evs if e["ph"] == "s"]
+    ends = [e for e in evs if e["ph"] == "f"]
+    assert len(starts) == 1 and len(ends) == 1
+    assert starts[0]["id"] == ends[0]["id"]
+    assert ends[0]["bp"] == "e"
+    assert starts[0]["tid"] != ends[0]["tid"]
+
+    insts = [e for e in evs if e["ph"] == "i"]
+    assert insts and insts[0]["s"] == "t"
+
+
+def test_export_perfetto_roundtrip_and_validate(tmp_path):
+    path = str(tmp_path / "obs" / "trace.json")
+    export_perfetto(_sample_tracer(), path)
+    assert validate_trace(path) == []
+    with open(path) as f:
+        obj = json.load(f)
+    assert obj["displayTimeUnit"] == "ms"
+    summ = trace_summary(path)
+    assert summ["spans"] == 3
+    assert summ["flows"] == 1
+    assert summ["counter_samples"] == 2
+    assert summ["counter_tracks"] == ["decode.queue_depth"]
+    assert HOST_TRACK in summ["rows"]
+
+
+def test_validate_trace_flags_corruption():
+    errs = validate_trace(
+        {
+            "traceEvents": [
+                {"ph": "Z", "name": "bad", "pid": 1, "tid": 1},
+                {"ph": "X", "name": "neg", "pid": 1, "tid": 1,
+                 "ts": 1.0, "dur": -2.0},
+                {"ph": "C", "name": "c", "pid": 1, "tid": 0,
+                 "ts": 0.0, "args": {}},
+                {"ph": "s", "name": "transfer", "pid": 1, "tid": 1,
+                 "ts": 0.0, "id": 7},  # start without finish
+            ]
+        }
+    )
+    assert len(errs) >= 4
+
+
+# ---------------------------------------------------------------------------
+# Schedule exporter extensions (flows + fence), backward compatible
+
+
+def _timed_schedule():
+    from distributed_llm_scheduler_tpu.backends.sim import SimulatedBackend
+    from distributed_llm_scheduler_tpu.frontend.generators import (
+        generate_llm_dag,
+    )
+
+    graph = generate_llm_dag(num_layers=3, num_heads=2, seed=1)
+    cluster = Cluster.uniform(2, 16.0)
+    schedule = get_scheduler("roundrobin").schedule(graph, cluster)
+    SimulatedBackend().execute(graph, cluster, schedule)
+    return graph, schedule
+
+
+def test_schedule_trace_transfer_flows_and_fence(tmp_path):
+    from distributed_llm_scheduler_tpu.utils.profiling import (
+        export_chrome_trace,
+    )
+
+    graph, schedule = _timed_schedule()
+    path = export_chrome_trace(
+        schedule, str(tmp_path / "t.json"), graph=graph
+    )
+    assert validate_trace(path) == []
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+
+    placement = schedule.placement
+    cross = sum(
+        1
+        for t in graph
+        for d in t.dependencies
+        if placement[d] != placement[t.task_id]
+    )
+    starts = [e for e in events if e["ph"] == "s"]
+    ends = [e for e in events if e["ph"] == "f"]
+    assert cross > 0 and len(starts) == cross and len(ends) == cross
+
+    fences = [e for e in events if e["ph"] == "i" and e["name"] == "run_fence"]
+    assert len(fences) == 1
+    assert fences[0]["tid"] == 0  # no extra thread row for the fence
+    threads = [e for e in events if e["name"] == "thread_name"]
+    assert len(threads) == len({t.node_id for t in schedule.timings.values()})
+
+
+def test_schedule_trace_without_graph_has_no_flows(tmp_path):
+    from distributed_llm_scheduler_tpu.utils.profiling import (
+        export_chrome_trace,
+    )
+
+    _, schedule = _timed_schedule()
+    path = export_chrome_trace(schedule, str(tmp_path / "t.json"))
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    assert not [e for e in events if e["ph"] in ("s", "f")]
+    assert [e for e in events if e["name"] == "run_fence"]
+
+
+# ---------------------------------------------------------------------------
+# Decode engine: TTFT / TPOT on a scripted clock
+
+
+def test_decode_engine_ttft_tpot_scripted_clock():
+    """Submit at t=10/12, admit (prefill) at t=20, retire at t=24 after 9
+    tokens in total -> TTFT {10, 8} and TPOT (24-20)/8 = 0.5 exactly."""
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+    from distributed_llm_scheduler_tpu.models.kv_pages import PagePool
+
+    cfg = gpt2.GPT2Config.tiny()
+    slots, ps, n_pages, ppseq = 2, 8, 32, 4
+    dag = build_paged_decode_dag(
+        cfg, slots=slots, page_size=ps, n_pages=n_pages, pages_per_seq=ppseq
+    )
+    params = dag.init_params()
+    weights = {
+        k: v
+        for k, v in params.items()
+        if not (k.startswith("cache_") or k == "page_table")
+    }
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+
+    clk = FakeClock(0.0)
+    tr = Tracer(clock=clk)
+    reg = MetricsRegistry()
+    eng = backend.paged_decode_engine(
+        dag.graph, sched, cfg, weights, pool,
+        slots=slots, pages_per_seq=ppseq, seg_steps=4,
+        trace=tr, metrics=reg, clock=clk,
+    )
+
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    clk.t = 10.0
+    eng.submit("r0", prompt, 9)
+    clk.t = 12.0
+    eng.submit("r1", prompt, 9)
+    clk.t = 20.0
+    eng.step_segment()  # admits both, runs first 4-step segment
+    clk.t = 24.0
+    eng.step_segment()  # final 4 steps -> both retire here
+
+    snap = reg.snapshot()
+    assert validate_snapshot(snap) == []
+    ttft = snap["histograms"]["decode.ttft_s"]
+    assert ttft["count"] == 2
+    assert ttft["min"] == pytest.approx(8.0)   # r1: 20 - 12
+    assert ttft["max"] == pytest.approx(10.0)  # r0: 20 - 10
+    tpot = snap["histograms"]["decode.tpot_s"]
+    assert tpot["count"] == 2
+    assert tpot["min"] == pytest.approx(0.5)
+    assert tpot["max"] == pytest.approx(0.5)
+    assert snap["counters"]["decode.requests_completed"]["value"] == 2
+    assert snap["gauges"]["decode.page_pool_occupancy_pages"]["max"] > 0
+
+    # trace side: admission wave + segments + retire instants all landed
+    names = [e["name"] for e in tr.events]
+    assert "admission_wave" in names and "prefill" in names
+    assert names.count("segment") == 2
+    retires = [e for e in tr.events if e["name"] == "retire"]
+    assert {e["args"]["rid"] for e in retires} == {"r0", "r1"}
+    assert "decode.queue_depth" in tr.counter_names()
+    assert "decode.page_pool_occupancy_pages" in tr.counter_names()
+    # engine returned every page (leak gauge wired in run(); check pool)
+    assert pool.free_pages == n_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# Ambient wiring + zero-overhead disabled path
+
+
+def test_ambient_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DLS_TRACE", raising=False)
+    reset_ambient()
+    try:
+        assert not trace_enabled()
+        assert ambient_tracer() is None
+        assert ambient_metrics() is None
+    finally:
+        reset_ambient()
+
+
+def test_ambient_enabled_is_process_wide_singleton(monkeypatch):
+    monkeypatch.setenv("DLS_TRACE", "1")
+    reset_ambient()
+    try:
+        assert trace_enabled()
+        tr = ambient_tracer()
+        assert tr is not None and ambient_tracer() is tr
+        mg = ambient_metrics()
+        assert mg is not None and ambient_metrics() is mg
+        reset_ambient()
+        assert ambient_tracer() is not tr
+    finally:
+        reset_ambient()
+
+
+def test_execute_traced_output_matches_untraced(monkeypatch):
+    """Explicit trace=/metrics= instrumentation must not perturb results,
+    and the disabled path must not record anything ambient."""
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import build_gpt2_dag
+    from distributed_llm_scheduler_tpu.models.gpt2 import GPT2Config
+
+    monkeypatch.delenv("DLS_TRACE", raising=False)
+    reset_ambient()
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=1, seq_len=8)
+    params = dag.init_params()
+    ids = dag.make_inputs()
+    cluster = Cluster.from_jax_devices(jax.devices()[:4])
+    schedule = get_scheduler("roundrobin").schedule(dag.graph, cluster)
+    backend = DeviceBackend(cluster)
+
+    plain = backend.execute(dag.graph, schedule, params, ids)
+
+    tr = Tracer()
+    reg = MetricsRegistry()
+    traced = backend.execute(
+        dag.graph, schedule, params, ids, trace=tr, metrics=reg
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain.output), np.asarray(traced.output)
+    )
+
+    names = {e["name"] for e in tr.events}
+    assert {"execute", "dispatch_order", "place_params"} <= names
+    assert tr.tracks()[0] == HOST_TRACK and len(tr.tracks()) > 1
+
+    snap = reg.snapshot()
+    assert validate_snapshot(snap) == []
+    assert snap["counters"]["dispatch.launches"]["value"] > 0
+    assert snap["histograms"]["execute.makespan_s"]["count"] == 1
+    # ambient stayed off: nothing leaked into the process-wide slot
+    assert ambient_tracer() is None
+    # exported trace from a real run is Perfetto-valid
+    evs = chrome_events(tr)
+    assert validate_trace({"traceEvents": evs}) == []
