@@ -566,7 +566,10 @@ def measure(
     # DLS_TRACE=1: the whole bench recorded into the ambient registry
     # (transfer bytes per edge, jit-cache hits, overhead histograms);
     # attach its snapshot to the artifact line
-    from distributed_llm_scheduler_tpu.obs import ambient_metrics
+    from distributed_llm_scheduler_tpu.obs import (
+        ambient_metrics,
+        ambient_tracer,
+    )
 
     _amb = ambient_metrics()
     if _amb is not None:
@@ -575,6 +578,18 @@ def measure(
         f"({rr*1e3:.3f} ms) -> {result.vs_baseline:.3f}x; "
         f"total bench {time.time()-t_start:.1f}s")
     out = result.to_json()
+    # run-doctor attribution of the last traced execute (the ambient
+    # tracer accumulates every leg; the window filter scopes it)
+    _atr = ambient_tracer()
+    if _atr is not None:
+        try:
+            from distributed_llm_scheduler_tpu.obs import attribute_run
+
+            _att = attribute_run(_atr)
+            if _att.critical_path:
+                out["attribution"] = _att.summary()
+        except Exception as e:
+            log(f"bench: WARNING attribution failed: {e}")
     # when the per-task calibration was actually measured (a TPU-platform
     # run can legitimately reuse a same-round cache; the stamp keeps that
     # distinct from a fresh measurement in the artifact itself)

@@ -12,6 +12,8 @@ Replaces the reference's four bare ``python <file>.py`` entry points
 * ``bench``     — the north-star benchmark (one JSON line)
 * ``trace``     — traced execute (+ paged-decode leg) -> Perfetto JSON
 * ``metrics``   — same run, metrics-registry snapshot JSON
+* ``doctor``    — measured critical-path attribution + cost-model drift
+* ``regress``   — fresh bench artifact vs committed baseline (gating)
 """
 
 from __future__ import annotations
@@ -210,8 +212,37 @@ def cmd_lint(args) -> int:
             shown = ", ".join(fixed[:5]) + ("..." if len(fixed) > 5 else "")
             print(f"--fix: deduplicated dependencies on {len(fixed)} "
                   f"task(s): {shown}", file=sys.stderr)
+    compiled_gb = analytic_gb = None
+    if args.preflight:
+        if not hasattr(dag, "init_params"):
+            print("--preflight needs a model DAG (gpt2*/llama*/mixtral*): "
+                  "XLA compiles the real task fns", file=sys.stderr)
+            return 2
+        from .utils.hbm import preflight_task_memory
+
+        # preflight mutates memory_required up to max(analytic,
+        # compiled): snapshot the analytic estimates first so the cost
+        # pass compares against what the frontend actually declared
+        analytic_gb = {t.task_id: t.memory_required for t in graph}
+        compiled_gb = preflight_task_memory(
+            graph, dag.init_params(), dag.make_inputs()
+        )
     cluster = cfg.build_cluster()
     schedule = cfg.build_scheduler().schedule(graph, cluster)
+    if args.fix:
+        from .analysis import fix_per_node_order
+
+        resorted = fix_per_node_order(graph, schedule)
+        if resorted is None:
+            print("--fix: no legal topological order exists (dependency "
+                  "cycle among placed tasks); order left as scheduled",
+                  file=sys.stderr)
+        elif resorted:
+            shown = ", ".join(resorted[:5]) + (
+                "..." if len(resorted) > 5 else ""
+            )
+            print(f"--fix: re-sorted execution order on {len(resorted)} "
+                  f"node(s): {shown}", file=sys.stderr)
 
     family = _weights_family(cfg.model)
     param_specs = getattr(dag, "param_specs", None)
@@ -228,6 +259,8 @@ def cmd_lint(args) -> int:
         mesh_axes=mesh_axes,
         family=family or "gpt2",
         param_specs=param_specs if cfg.quantize == "int8" else None,
+        compiled_gb=compiled_gb,
+        analytic_gb=analytic_gb,
     )
     if schedule.failed:
         print(f"note: scheduler failed {len(schedule.failed)} task(s) "
@@ -507,6 +540,21 @@ def cmd_visualize(args) -> int:
     from .visu.plots import visualize_dag, visualize_schedule
 
     cfg = _config_from(args)
+    if getattr(args, "from_trace", None):
+        # measured gantt: render the exported trace's device spans (what
+        # actually ran under DLS_TRACE=1), not a fresh simulated replay
+        from .visu.plots import visualize_trace_gantt
+
+        stem = os.path.splitext(os.path.basename(args.from_trace))[0]
+        try:
+            print("gantt ->", visualize_trace_gantt(
+                args.from_trace, f"{cfg.out_dir}/{stem}.gantt.png",
+                show=args.show,
+            ))
+        except (OSError, ValueError) as e:
+            print(f"--from-trace {args.from_trace}: {e}", file=sys.stderr)
+            return 2
+        return 0
     if getattr(args, "menu", False):
         return _visualize_menu(args, cfg)
     dag = cfg.build_graph()
@@ -1136,6 +1184,107 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """Run doctor: measured critical-path attribution (+ cost-model
+    drift when the run is live).  ``--trace`` diagnoses an exported
+    trace JSON offline; without it, one profiled ``DeviceBackend``
+    execute of the model DAG is attributed directly.  Exit 2 when
+    nothing is attributable, 1 when drift exceeds ``--drift-threshold``,
+    0 otherwise."""
+    from .obs.attribution import attribute_run, attribute_trace
+
+    if args.trace:
+        try:
+            att = attribute_trace(args.trace)
+        except (OSError, ValueError) as e:
+            print(f"doctor: unreadable trace {args.trace}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not att.critical_path:
+            print("doctor: trace has no attributable device spans",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps({"attribution": att.summary()}, indent=1))
+        return 0
+
+    from .backends.device import DeviceBackend
+    from .obs.drift import compute_drift
+    from .obs.trace import Tracer
+
+    cfg = _config_from(args)
+    dag = cfg.build_graph()
+    if not hasattr(dag, "graph"):
+        print("doctor needs a model DAG (gpt2* / llama* / mixtral*) or "
+              "an exported trace via --trace", file=sys.stderr)
+        return 2
+    cost_model = None
+    if args.costmodel:
+        from .utils.costmodel import CostModel
+
+        try:
+            cost_model = CostModel.load(args.costmodel)
+        except (OSError, ValueError) as e:
+            print(f"doctor: --costmodel {args.costmodel}: {e}",
+                  file=sys.stderr)
+            return 2
+        # schedule against the predictions being audited, exactly like
+        # a calibrated bench run would
+        cost_model.apply(dag.graph)
+    cluster = cfg.build_cluster_with_devices()
+    schedule = cfg.build_scheduler().schedule(dag.graph, cluster)
+    tracer = Tracer()
+    DeviceBackend(cluster).execute(
+        dag.graph, schedule, dag.init_params(), dag.make_inputs(),
+        profile=True, trace=tracer,
+    )
+    att = attribute_run(tracer)
+    drift = compute_drift(dag.graph, schedule, cost_model)
+    print(json.dumps(
+        {"attribution": att.summary(), "drift": drift.summary()},
+        indent=1,
+    ))
+    if not att.critical_path:
+        print("doctor: run produced no attributable device spans",
+              file=sys.stderr)
+        return 2
+    if drift.exceeds(args.drift_threshold):
+        print(f"doctor: worst per-task drift ratio "
+              f"{drift.worst_ratio():.2f}x exceeds the "
+              f"--drift-threshold {args.drift_threshold:g}x gate",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_regress(args) -> int:
+    """Compare a fresh bench artifact against a committed baseline;
+    exit with the verdict (non-zero on any regressed/missing metric)."""
+    from .eval.regress import compare_artifacts, parse_tolerances
+
+    try:
+        tolerances = parse_tolerances(args.tolerance or [])
+    except ValueError as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+    metrics = None
+    if args.metrics:
+        metrics = [m.strip() for m in args.metrics.split(",") if m.strip()]
+    try:
+        verdict = compare_artifacts(
+            args.fresh, args.baseline,
+            tolerances=tolerances, metrics=metrics,
+            default_tolerance=args.default_tolerance,
+        )
+    except (OSError, ValueError) as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(verdict.to_json(), indent=1))
+    else:
+        print(verdict.render())
+    return verdict.exit_code
+
+
 def cmd_bench(args) -> int:
     import importlib.util
     import os
@@ -1189,8 +1338,14 @@ def main(argv=None) -> int:
                         "(--batch sets the slot count; gpt2 family only)")
     p.add_argument("--fix", action="store_true",
                    help="apply mechanical fixes before linting "
-                        "(DAG003 duplicate-dependency dedup; arg_tasks "
-                        "keeps the original call arity)")
+                        "(DAG003 duplicate-dependency dedup keeping the "
+                        "original call arity; SCH005/PIP001 per-node "
+                        "order re-sort when a legal topological order "
+                        "exists)")
+    p.add_argument("--preflight", action="store_true",
+                   help="also run the XLA compiled-memory preflight and "
+                        "flag tasks whose analytic estimate diverges >2x "
+                        "from it (CST00x warnings; model DAGs only)")
     p.add_argument("--strict", action="store_true",
                    help="treat eviction-required residency (MEM002) as an "
                         "error")
@@ -1242,6 +1397,12 @@ def main(argv=None) -> int:
                    help="stdin-driven menu loop: re-render DAG/Gantt, "
                         "switch policies, and print summaries without "
                         "re-running the CLI")
+    p.add_argument("--from-trace", default=None, dest="from_trace",
+                   metavar="TRACE_JSON",
+                   help="render the gantt from an exported trace JSON "
+                        "(measured spans from a DLS_TRACE=1 run) instead "
+                        "of a simulated replay; critical-path spans get "
+                        "a highlight edge")
     p.set_defaults(fn=cmd_visualize)
 
     p = sub.add_parser("train", help="run sharded training steps")
@@ -1345,6 +1506,52 @@ def main(argv=None) -> int:
     p.add_argument("--skip-decode", action="store_true", dest="skip_decode",
                    help="skip the paged decode leg")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "doctor",
+        help="explain a run: measured critical-path attribution "
+             "(compute/transfer/dispatch/idle) + cost-model drift",
+    )
+    _add_common(p)
+    p.add_argument("--trace", default=None, metavar="TRACE_JSON",
+                   help="diagnose an exported trace JSON offline instead "
+                        "of running a profiled execute")
+    p.add_argument("--costmodel", default=None, metavar="PATH",
+                   help="calibrated CostModel JSON (utils/costmodel "
+                        "cache entry) to audit; defaults to the graph's "
+                        "analytic compute_time estimates")
+    p.add_argument("--drift-threshold", type=float, default=None,
+                   dest="drift_threshold", metavar="RATIO",
+                   help="exit 1 when any task's two-sided predicted-vs-"
+                        "measured ratio max(r, 1/r) exceeds RATIO "
+                        "(default: report only, never gate)")
+    p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "regress",
+        help="perf-regression gate: fresh bench artifact vs committed "
+             "baseline with per-metric tolerances (non-zero on regression)",
+    )
+    p.add_argument("--fresh", required=True,
+                   help="freshly measured bench artifact JSON")
+    p.add_argument("--baseline", required=True,
+                   help="committed baseline artifact (e.g. "
+                        "BENCH_MEDIUM_r05.json)")
+    p.add_argument("--tolerance", action="append", default=None,
+                   metavar="METRIC=FRAC",
+                   help="per-metric relative tolerance (repeatable), "
+                        "e.g. --tolerance value=0.25")
+    p.add_argument("--default-tolerance", type=float, default=0.10,
+                   dest="default_tolerance",
+                   help="tolerance for metrics without an explicit "
+                        "--tolerance (default 0.10)")
+    p.add_argument("--metrics", default=None,
+                   help="comma-separated metric names to check (default: "
+                        "the quality set present in the baseline)")
+    p.add_argument("--json", action="store_true",
+                   help="print the structured verdict instead of the "
+                        "table")
+    p.set_defaults(fn=cmd_regress)
 
     p = sub.add_parser(
         "rankcheck",

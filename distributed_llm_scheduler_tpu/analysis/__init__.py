@@ -30,8 +30,9 @@ from .diagnostics import (
     Diagnostic,
     Severity,
 )
+from .cost_pass import analyze_cost
 from .decode_pass import analyze_decode
-from .fixes import fix_duplicate_dependencies
+from .fixes import fix_duplicate_dependencies, fix_per_node_order
 from .graph_pass import analyze_graph
 from .memory_pass import analyze_memory
 from .pipeline_pass import analyze_pipeline
@@ -46,6 +47,7 @@ __all__ = [
     "Diagnostic",
     "Severity",
     "analyze",
+    "analyze_cost",
     "analyze_decode",
     "analyze_graph",
     "analyze_memory",
@@ -54,6 +56,7 @@ __all__ = [
     "analyze_schedule",
     "analyze_sharding",
     "fix_duplicate_dependencies",
+    "fix_per_node_order",
     "gate_enabled",
     "pre_execution_gate",
 ]
@@ -78,13 +81,17 @@ def analyze(
     family: str = "gpt2",
     seq_parallel: bool = False,
     param_specs: Optional[Dict[str, Any]] = None,
+    compiled_gb: Optional[Dict[str, float]] = None,
+    analytic_gb: Optional[Dict[str, float]] = None,
 ) -> AnalysisReport:
     """Run every pass the provided inputs make applicable.
 
     Graph hygiene always runs; schedule-consistency, memory, and pipeline
     passes run when ``cluster`` and ``schedule`` are given; the sharding
     pass runs when ``param_shapes`` + ``mesh_axes`` are given; the
-    quantization pass runs when ``param_specs`` is given.
+    quantization pass runs when ``param_specs`` is given; the cost pass
+    runs when ``compiled_gb`` (an ``utils.hbm.preflight_task_memory``
+    result, with ``analytic_gb`` the pre-preflight snapshot) is given.
     """
     rep = analyze_graph(graph)
     rep.extend(analyze_decode(graph, cluster, schedule))
@@ -103,6 +110,8 @@ def analyze(
         )
     if param_specs is not None:
         rep.extend(analyze_quantization(graph, param_specs))
+    if compiled_gb is not None:
+        rep.extend(analyze_cost(graph, compiled_gb, analytic_gb))
     return rep
 
 

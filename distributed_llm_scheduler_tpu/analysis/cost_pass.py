@@ -1,0 +1,98 @@
+"""Cost-model fidelity pass (CST00x): analytic memory vs XLA preflight.
+
+The schedulers place against each task's *analytic* ``memory_required``
+(GB) — frontend builders derive it from shapes.  ``utils/hbm.py``'s
+``preflight_task_memory`` asks XLA's compiled cost analysis what each
+task actually allocates.  When the two diverge by more than ``factor``
+(default 2×) in either direction, every memory-feasibility decision
+built on the analytic number (MEM00x, streaming budgets, segment caps)
+is suspect — this pass surfaces that as warnings, never errors: a bad
+estimate degrades placement quality, it does not corrupt execution, so
+CST codes are deliberately absent from the backends' gate sets.
+
+Caveat the caller must respect: ``preflight_task_memory`` *mutates*
+``task.memory_required`` up to ``max(analytic, compiled)``.  Snapshot
+the analytic values first and pass them as ``analytic_gb`` (the `lint
+--preflight` CLI path does); without the snapshot this pass compares
+against the already-raised values and can only catch over-prediction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..core.graph import TaskGraph
+from .diagnostics import AnalysisReport, Severity
+
+#: divergence threshold: flag when one estimate exceeds ``factor`` times
+#: the other (two-sided)
+DEFAULT_FACTOR = 2.0
+
+#: estimates below this (GB) are noise — scalar glue tasks round-trip
+#: through XLA with ~KB footprints and any ratio there is meaningless
+_FLOOR_GB = 1e-3
+
+
+def analyze_cost(
+    graph: TaskGraph,
+    compiled_gb: Dict[str, float],
+    analytic_gb: Optional[Dict[str, float]] = None,
+    factor: float = DEFAULT_FACTOR,
+) -> AnalysisReport:
+    """Compare analytic vs compiled per-task memory, flag >factor gaps.
+
+    ``compiled_gb`` is ``utils.hbm.preflight_task_memory``'s result;
+    ``analytic_gb`` the pre-preflight ``memory_required`` snapshot
+    (falls back to the graph's current values).
+    """
+    rep = AnalysisReport()
+    for task in graph.tasks():
+        tid = task.task_id
+        analytic = (
+            analytic_gb.get(tid, task.memory_required)
+            if analytic_gb is not None
+            else task.memory_required
+        )
+        if tid not in compiled_gb:
+            if analytic > _FLOOR_GB:
+                rep.add(
+                    "CST003",
+                    Severity.INFO,
+                    f"no XLA preflight measurement for {tid!r} "
+                    f"(analytic {analytic:.3f} GB unchecked)",
+                    task=tid,
+                    data={"analytic_gb": analytic},
+                )
+            continue
+        compiled = compiled_gb[tid]
+        if analytic <= _FLOOR_GB and compiled <= _FLOOR_GB:
+            continue
+        data = {
+            "analytic_gb": analytic,
+            "compiled_gb": compiled,
+            "factor": factor,
+        }
+        if compiled > factor * max(analytic, _FLOOR_GB):
+            rep.add(
+                "CST001",
+                Severity.WARNING,
+                f"analytic memory {analytic:.3f} GB under-predicts XLA "
+                f"preflight {compiled:.3f} GB by more than {factor:g}x; "
+                "placement may overcommit HBM",
+                task=tid,
+                data=data,
+            )
+        elif analytic > factor * max(compiled, _FLOOR_GB):
+            rep.add(
+                "CST002",
+                Severity.WARNING,
+                f"analytic memory {analytic:.3f} GB over-predicts XLA "
+                f"preflight {compiled:.3f} GB by more than {factor:g}x; "
+                "placement is wastefully conservative",
+                task=tid,
+                data=data,
+            )
+    return rep
+
+
+__all__ = ["DEFAULT_FACTOR", "analyze_cost"]

@@ -73,6 +73,10 @@ CODES: Dict[str, str] = {
     "QNT002": "QParam scale shape matches no known layout",
     "QNT003": "quantized param that should_quantize would reject",
     "QNT004": "task param_bytes disagree with quantized size",
+    # -- cost-model fidelity (cost_pass) --------------------------------
+    "CST001": "analytic memory estimate under-predicts XLA preflight",
+    "CST002": "analytic memory estimate over-predicts XLA preflight",
+    "CST003": "task missing from XLA preflight measurement",
 }
 
 

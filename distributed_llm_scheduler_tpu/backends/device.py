@@ -96,6 +96,10 @@ class DeviceReport:
     param_load_bytes: int = 0
     param_evictions: int = 0
     peak_param_bytes: Dict[str, int] = field(default_factory=dict)
+    # traced runs only: the run doctor's measured critical-path summary
+    # (obs/attribution.py) over this execute's span window — makespan
+    # split into compute/transfer/dispatch/idle plus stragglers/bubbles
+    attribution: Optional[Dict[str, Any]] = None
 
     @property
     def total_param_gb_placed(self) -> float:
@@ -131,6 +135,11 @@ class DeviceReport:
                     },
                 }
                 if self.streamed
+                else {}
+            ),
+            **(
+                {"attribution": self.attribution}
+                if self.attribution is not None
                 else {}
             ),
         }
@@ -1671,8 +1680,22 @@ class DeviceBackend:
                     mreg.gauge(f"device.utilization.{n}", unit="frac").set(
                         b / span_end if span_end > 0 else 0.0
                     )
+        attribution = None
         if ev_exec is not None:
             tracer.end(ev_exec, makespan_s=makespan)
+            # run doctor: attribute this execute's span window (window
+            # filtering keeps ambient tracers that accumulated earlier
+            # runs correct).  Diagnosis only — never fail the run on it.
+            try:
+                from ..obs.attribution import attribute_run
+
+                att = attribute_run(
+                    tracer, window=(ev_exec["t0"], ev_exec["t1"]),
+                )
+                if att.critical_path:
+                    attribution = att.summary()
+            except Exception:
+                attribution = None
         return DeviceReport(
             policy=schedule.policy,
             makespan_s=makespan,
@@ -1695,4 +1718,5 @@ class DeviceBackend:
             param_load_bytes=streamer.load_bytes if streamer else 0,
             param_evictions=streamer.evictions if streamer else 0,
             peak_param_bytes=dict(streamer.peak) if streamer else {},
+            attribution=attribution,
         )

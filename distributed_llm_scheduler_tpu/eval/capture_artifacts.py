@@ -307,6 +307,7 @@ def main(argv) -> int:
     rc = 0
     from distributed_llm_scheduler_tpu.obs import (
         ambient_metrics,
+        ambient_tracer,
         reset_ambient,
     )
 
@@ -322,6 +323,17 @@ def main(argv) -> int:
         amb = ambient_metrics()
         if amb is not None:
             out["obs_metrics"] = amb.snapshot()
+        atr = ambient_tracer()
+        if atr is not None:
+            # run-doctor attribution of the leg's last traced execute
+            try:
+                from distributed_llm_scheduler_tpu.obs import attribute_run
+
+                att = attribute_run(atr)
+                if att.critical_path:
+                    out["obs_attribution"] = att.summary()
+            except Exception as e:
+                log(f"capture[{w}]: attribution failed: {e}")
         path = os.path.join(REPO_ROOT, f"{prefix}_r{round_n:02d}.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=1)

@@ -1,0 +1,251 @@
+"""Perf-regression gating: fresh bench leg vs committed baseline.
+
+The repo commits bench artifacts (``BENCH_MEDIUM_r05.json`` et al.);
+this module compares a freshly-measured artifact against one of them
+metric by metric, with per-metric direction ("lower is better" for
+makespans and overheads, "higher is better" for speedups and MFU,
+boolean for oracle checks) and per-metric relative tolerances, and
+renders a structured verdict the ``regress`` CLI turns into an exit
+code.  CI runs it on the 8-virtual-device CPU mesh with loose
+tolerances; a 20% makespan regression fails the build, the committed
+baseline compared against itself passes by construction.
+
+Tolerance semantics are inclusive: a lower-is-better metric regresses
+only when ``fresh > baseline * (1 + tol)`` — landing exactly on the
+edge is still ``ok``.  A metric present in the baseline but absent
+from the fresh artifact is a ``missing`` failure (a silently-dropped
+bench leg must not read as a pass).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+# direction per known bench-artifact metric; anything not listed here is
+# compared only when explicitly requested via `metrics=` (and must then
+# appear in one of the maps)
+LOWER_BETTER = (
+    "value",                  # headline makespan (ms)
+    "segmented_makespan_ms",
+    "fused_forward_ms",
+    "fused_scalar_ms",
+    "dispatch_overhead",
+    "peak_hbm_gb_modeled",
+    "singlechip_replay_ms",
+    "fence_rtt_ms",
+)
+HIGHER_BETTER = (
+    "vs_baseline",
+    "mfu_single_chip",
+    "mfu_segmented",
+)
+BOOL_METRICS = ("oracle_ok",)
+
+# the default comparison set: quality metrics only — environment
+# measurements (fence RTT, replay wall) drift with the machine and are
+# opted into explicitly
+DEFAULT_METRICS = (
+    "value",
+    "vs_baseline",
+    "segmented_makespan_ms",
+    "dispatch_overhead",
+    "peak_hbm_gb_modeled",
+    "mfu_single_chip",
+    "mfu_segmented",
+    "oracle_ok",
+)
+
+DEFAULT_TOLERANCE = 0.10
+
+
+@dataclass
+class MetricCheck:
+    metric: str
+    direction: str  # "lower" | "higher" | "bool"
+    baseline: Any
+    fresh: Any
+    tolerance: float
+    status: str  # "ok" | "improved" | "regressed" | "missing"
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {
+            "metric": self.metric, "direction": self.direction,
+            "baseline": self.baseline, "fresh": self.fresh,
+            "tolerance": self.tolerance, "status": self.status,
+        }
+        if (
+            isinstance(self.baseline, (int, float))
+            and not isinstance(self.baseline, bool)
+            and isinstance(self.fresh, (int, float))
+            and self.baseline
+        ):
+            out["ratio"] = self.fresh / self.baseline
+        return out
+
+
+@dataclass
+class RegressVerdict:
+    checks: List[MetricCheck] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(c.status in ("ok", "improved") for c in self.checks)
+
+    @property
+    def exit_code(self) -> int:
+        return 0 if self.ok else 1
+
+    def failures(self) -> List[MetricCheck]:
+        return [
+            c for c in self.checks
+            if c.status in ("regressed", "missing")
+        ]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "n_checks": len(self.checks),
+            "n_regressed": sum(
+                1 for c in self.checks if c.status == "regressed"
+            ),
+            "n_missing": sum(
+                1 for c in self.checks if c.status == "missing"
+            ),
+            "checks": [c.to_json() for c in self.checks],
+        }
+
+    def render(self) -> str:
+        lines = []
+        for c in self.checks:
+            mark = {
+                "ok": " ", "improved": "+", "regressed": "!",
+                "missing": "?",
+            }[c.status]
+            lines.append(
+                f"[{mark}] {c.metric:<24} baseline={c.baseline!r:<12} "
+                f"fresh={c.fresh!r:<12} tol={c.tolerance:.0%} "
+                f"-> {c.status}"
+            )
+        verdict = "PASS" if self.ok else "FAIL"
+        lines.append(
+            f"regress: {verdict} "
+            f"({len(self.checks)} checks, {len(self.failures())} failing)"
+        )
+        return "\n".join(lines)
+
+
+def load_artifact(path_or_obj: Any) -> Dict[str, Any]:
+    """Load a bench artifact; unwraps the driver capture format
+    (``{"n", "cmd", "rc", "parsed": {...}}``) down to the metric dict."""
+    obj = path_or_obj
+    if isinstance(path_or_obj, (str, os.PathLike)):
+        with open(path_or_obj) as f:
+            obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError("bench artifact must be a JSON object")
+    if "metric" not in obj and isinstance(obj.get("parsed"), dict):
+        obj = obj["parsed"]
+    return obj
+
+
+def _direction(metric: str) -> Optional[str]:
+    if metric in BOOL_METRICS:
+        return "bool"
+    if metric in LOWER_BETTER:
+        return "lower"
+    if metric in HIGHER_BETTER:
+        return "higher"
+    return None
+
+
+def compare_artifacts(
+    fresh: Any,
+    baseline: Any,
+    tolerances: Optional[Dict[str, float]] = None,
+    metrics: Optional[Sequence[str]] = None,
+    default_tolerance: float = DEFAULT_TOLERANCE,
+) -> RegressVerdict:
+    """Compare two bench artifacts (paths or dicts) metric by metric.
+
+    Only metrics present in the *baseline* are checked (the baseline
+    defines the contract); of those, the default set is
+    :data:`DEFAULT_METRICS` unless ``metrics`` narrows or extends it.
+    ``tolerances`` maps metric name → relative tolerance, with
+    ``default_tolerance`` as the fallback.
+    """
+    fresh = load_artifact(fresh)
+    baseline = load_artifact(baseline)
+    tolerances = tolerances or {}
+    wanted = list(metrics) if metrics is not None else [
+        m for m in DEFAULT_METRICS if m in baseline
+    ]
+    checks: List[MetricCheck] = []
+    for m in wanted:
+        direction = _direction(m)
+        if direction is None:
+            direction = "lower"  # explicit unknown metrics: conservative
+        if m not in baseline:
+            continue
+        base = baseline[m]
+        tol = float(tolerances.get(m, default_tolerance))
+        if m not in fresh or fresh[m] is None:
+            checks.append(MetricCheck(m, direction, base, None, tol,
+                                      "missing"))
+            continue
+        new = fresh[m]
+        if direction == "bool":
+            if bool(base) and not bool(new):
+                status = "regressed"
+            elif not bool(base) and bool(new):
+                status = "improved"
+            else:
+                status = "ok"
+        elif not isinstance(base, (int, float)) or isinstance(base, bool) \
+                or not isinstance(new, (int, float)):
+            status = "ok" if new == base else "regressed"
+        elif direction == "lower":
+            if new > base * (1.0 + tol):
+                status = "regressed"
+            elif new < base * (1.0 - tol):
+                status = "improved"
+            else:
+                status = "ok"
+        else:  # higher is better
+            if new < base * (1.0 - tol):
+                status = "regressed"
+            elif new > base * (1.0 + tol):
+                status = "improved"
+            else:
+                status = "ok"
+        checks.append(MetricCheck(m, direction, base, new, tol, status))
+    return RegressVerdict(checks=checks)
+
+
+def parse_tolerances(specs: Sequence[str]) -> Dict[str, float]:
+    """Parse CLI ``--tolerance metric=frac`` specs (repeatable)."""
+    out: Dict[str, float] = {}
+    for spec in specs:
+        if "=" not in spec:
+            raise ValueError(
+                f"tolerance spec {spec!r} is not metric=frac"
+            )
+        k, v = spec.split("=", 1)
+        out[k.strip()] = float(v)
+    return out
+
+
+__all__ = [
+    "BOOL_METRICS",
+    "DEFAULT_METRICS",
+    "DEFAULT_TOLERANCE",
+    "HIGHER_BETTER",
+    "LOWER_BETTER",
+    "MetricCheck",
+    "RegressVerdict",
+    "compare_artifacts",
+    "load_artifact",
+    "parse_tolerances",
+]

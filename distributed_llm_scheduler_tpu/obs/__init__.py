@@ -8,7 +8,10 @@ subsystems (planned dispatch, segment fusion, paged decode):
 * :mod:`.metrics` — counters/gauges/histograms with a stable JSON
   snapshot schema;
 * :mod:`.export` — Chrome/Perfetto rendering of either a tracer's
-  unified timeline or a timed schedule.
+  unified timeline or a timed schedule;
+* :mod:`.attribution` — the run doctor's measured critical-path
+  reconstruction and compute/transfer/dispatch/idle makespan split;
+* :mod:`.drift` — per-task predicted-vs-measured cost-model audit.
 
 Everything is opt-in.  Two ways to turn it on:
 
@@ -32,6 +35,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from .attribution import Attribution, attribute_run, attribute_trace
+from .drift import DriftReport, compute_drift
 from .metrics import MetricsRegistry
 from .trace import HOST_TRACK, Tracer
 
@@ -76,11 +81,16 @@ def reset_ambient() -> None:
 
 
 __all__ = [
+    "Attribution",
+    "DriftReport",
     "HOST_TRACK",
     "MetricsRegistry",
     "Tracer",
     "ambient_metrics",
     "ambient_tracer",
+    "attribute_run",
+    "attribute_trace",
+    "compute_drift",
     "reset_ambient",
     "trace_enabled",
 ]

@@ -400,6 +400,101 @@ def test_fix_duplicate_dependencies_rebuilds_frozen_edges():
     assert g.dependents("a") == ["b"]  # stale duplicate edge rebuilt away
 
 
+def test_fix_per_node_order_repairs_inversions():
+    from distributed_llm_scheduler_tpu.analysis import fix_per_node_order
+
+    g = TaskGraph([
+        Task("a", 0.1, 1.0, [], set()),
+        Task("b", 0.1, 1.0, ["a"], set()),
+        Task("c", 0.1, 1.0, ["b"], set()),
+    ]).freeze()
+    s = sched({"n0": ["b", "a"], "n1": ["c"]})  # PIP001: b before its dep a
+    assert analyze_pipeline(g, s).has("PIP001")
+    before_placement = dict(s.placement)
+    changed = fix_per_node_order(g, s)
+    assert changed == ["n0"]
+    assert s.per_node["n0"] == ["a", "b"]
+    assert s.assignment_order == ["a", "b", "c"]
+    assert s.placement == before_placement      # where is untouched
+    assert not analyze_pipeline(g, s).has("PIP001")
+    assert not analyze_schedule(g, two_caps(), s).has("SCH005")
+    assert fix_per_node_order(g, s) == []       # already legal: no-op
+
+
+def test_fix_per_node_order_none_on_cycle_and_stays_close():
+    from distributed_llm_scheduler_tpu.analysis import fix_per_node_order
+
+    cyc = TaskGraph([
+        Task("a", 0.1, 1.0, ["b"], set()),
+        Task("b", 0.1, 1.0, ["a"], set()),
+    ])
+    s = sched({"n0": ["b", "a"]})
+    snapshot = [list(s.per_node["n0"]), list(s.assignment_order)]
+    assert fix_per_node_order(cyc, s) is None   # no legal order exists
+    assert [list(s.per_node["n0"]), list(s.assignment_order)] == snapshot
+
+    # tie-break keeps the repaired order as close to the original as a
+    # legal order allows: independent x/y keep their relative order
+    g = TaskGraph([
+        Task("x", 0.1, 1.0, [], set()),
+        Task("y", 0.1, 1.0, [], set()),
+        Task("z", 0.1, 1.0, ["y"], set()),
+    ])
+    s2 = sched({"n0": ["z", "x", "y"]})
+    assert fix_per_node_order(g, s2) == ["n0"]
+    assert s2.per_node["n0"] == ["x", "y", "z"]
+
+
+# -- cost pass (CST00x): analytic memory vs XLA preflight --------------------
+
+def test_cost_pass_flags_two_sided_divergence():
+    from distributed_llm_scheduler_tpu.analysis import analyze_cost
+
+    g = TaskGraph([
+        Task("under", 1.0, 1.0, [], set()),
+        Task("over", 8.0, 1.0, ["under"], set()),
+        Task("fine", 1.0, 1.0, ["under"], set()),
+        Task("unmeasured", 1.0, 1.0, ["over"], set()),
+    ])
+    compiled = {"under": 3.0, "over": 2.0, "fine": 1.5}
+    rep = analyze_cost(g, compiled)
+    (u,) = rep.by_code("CST001")
+    assert u.task == "under" and u.severity == Severity.WARNING
+    assert u.data["compiled_gb"] == 3.0 and u.data["factor"] == 2.0
+    (o,) = rep.by_code("CST002")
+    assert o.task == "over"
+    (m,) = rep.by_code("CST003")
+    assert m.task == "unmeasured" and m.severity == Severity.INFO
+    # warnings only: cost drift degrades placement, it never gates
+    assert rep.exit_code == 0
+
+
+def test_cost_pass_snapshot_and_floor():
+    from distributed_llm_scheduler_tpu.analysis import analyze_cost
+
+    # preflight mutated memory_required up to the compiled value; only
+    # the analytic_gb snapshot lets the pass still see under-prediction
+    g = TaskGraph([Task("t", 3.0, 1.0, [], set())])  # already raised
+    rep = analyze_cost(g, {"t": 3.0}, analytic_gb={"t": 1.0})
+    assert rep.has("CST001")
+    assert not analyze_cost(g, {"t": 3.0}).has("CST001")
+    # sub-floor scalar glue never flags, in either direction
+    tiny = TaskGraph([Task("s", 1e-6, 1.0, [], set())])
+    assert analyze_cost(tiny, {"s": 5e-4}).ok
+    assert not analyze_cost(tiny, {}).has("CST003")
+    # custom factor widens the accepted band
+    g2 = TaskGraph([Task("t", 1.0, 1.0, [], set())])
+    assert analyze_cost(g2, {"t": 2.5}).has("CST001")
+    assert analyze_cost(g2, {"t": 2.5}, factor=3.0).ok
+
+
+def test_analyze_wires_compiled_gb_through():
+    g = TaskGraph([Task("t", 1.0, 1.0, [], set())])
+    rep = analyze(g, compiled_gb={"t": 5.0}, analytic_gb={"t": 1.0})
+    assert rep.has("CST001")
+    assert analyze(g).ok  # pass only runs when compiled_gb is given
+
+
 # -- pre-execution gate ------------------------------------------------------
 
 def corrupted():

@@ -9,10 +9,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+from distributed_llm_scheduler_tpu import Cluster, Task, TaskGraph, get_scheduler
 from distributed_llm_scheduler_tpu.obs import (
     ambient_metrics,
     ambient_tracer,
+    attribute_run,
+    attribute_trace,
+    compute_drift,
     reset_ambient,
     trace_enabled,
 )
@@ -445,3 +448,176 @@ def test_execute_traced_output_matches_untraced(monkeypatch):
     # exported trace from a real run is Perfetto-valid
     evs = chrome_events(tr)
     assert validate_trace({"traceEvents": evs}) == []
+
+    # the traced run self-attributes; the untraced run has nothing to
+    assert plain.attribution is None and "attribution" not in plain.summary()
+    att = traced.attribution
+    assert att is not None and att["critical_path"]
+    assert sum(att["fractions"].values()) == pytest.approx(1.0, abs=1e-6)
+    assert traced.summary()["attribution"] is att
+
+
+# ---------------------------------------------------------------------------
+# Attribution (run doctor)
+
+
+def _doctor_tracer():
+    """Scripted-clock scenario with a known critical path:
+
+    host    : execute [0, 9]; dispatch_order [0, 0.2]; place_params [0.2, 0.8]
+    core_0  : task_a [1, 3], task_b [3, 4.5]
+    core_1  : task_c [5, 8]   <- flow from task_b@4.5 releases at 5.0
+
+    Critical path task_a -> task_b -> task_c; makespan 8.0 tiles into
+    compute 6.5 + transfer 0.5 + dispatch 0.8 + idle 0.2.
+    """
+    clk = FakeClock(0.0)
+    tr = Tracer(clock=clk)
+    ex = tr.begin("execute", cat="schedule", policy="manual")
+    tr.complete("dispatch_order", 0.0, 0.2, cat="schedule")
+    tr.complete("place_params", 0.2, 0.8, cat="stage")
+    tr.complete("task_a", 1.0, 3.0, track="core_0", cat="task", tid="task_a")
+    tr.complete("task_b", 3.0, 4.5, track="core_0", cat="task", tid="task_b")
+    tr.complete("task_c", 5.0, 8.0, track="core_1", cat="task", tid="task_c")
+    tr.flow("transfer", "core_0", 4.5, "core_1", 5.0,
+            src="task_b", dst="task_c", bytes=64)
+    clk.t = 9.0
+    tr.end(ex)
+    return tr
+
+
+def test_attribution_golden_critical_path():
+    att = attribute_run(_doctor_tracer())
+    assert [s.name for s in att.critical_path] == ["task_a", "task_b", "task_c"]
+    assert att.makespan_s == pytest.approx(8.0)
+    b = att.breakdown_s
+    assert b["compute"] == pytest.approx(6.5)
+    assert b["transfer"] == pytest.approx(0.5)
+    assert b["dispatch"] == pytest.approx(0.8)
+    assert b["idle"] == pytest.approx(0.2)
+    # exact tiling invariant: the four buckets sum to the makespan
+    assert abs(sum(b.values()) - att.makespan_s) < 1e-9
+    assert sum(att.fractions().values()) == pytest.approx(1.0, abs=1e-9)
+
+    step_a, step_b, step_c = att.critical_path
+    assert step_a.wait_kind == "wait" and step_a.wait_s == pytest.approx(1.0)
+    assert step_b.wait_kind == "" and step_b.wait_s == 0.0
+    assert step_c.wait_kind == "transfer"
+    assert step_c.wait_s == pytest.approx(0.5)
+    # summary is JSON-round-trippable
+    assert json.loads(json.dumps(att.summary()))["makespan_s"] == 8.0
+
+
+def test_attribution_stragglers_bubbles_per_device():
+    att = attribute_run(_doctor_tracer())
+    assert att.stragglers == ["core_1"]
+    # three idle windows overlap the critical path's wait gaps, the
+    # biggest being core_1's [0, 5] lead-in (1.5s of path waits inside)
+    assert len(att.bubbles) == 3
+    top = att.bubbles[0]
+    assert top["device"] == "core_1"
+    assert top["critical_overlap_s"] == pytest.approx(1.5)
+    pd = att.per_device
+    assert pd["core_0"]["busy_s"] == pytest.approx(3.5)
+    assert pd["core_1"]["busy_s"] == pytest.approx(3.0)
+    assert pd["core_1"]["utilization"] == pytest.approx(3.0 / 8.0)
+    assert pd["core_1"]["last_finish_s"] == pytest.approx(8.0)
+
+
+def test_attribution_roundtrip_through_export(tmp_path):
+    tr = _doctor_tracer()
+    live = attribute_run(tr)
+    path = tmp_path / "trace.json"
+    export_perfetto(tr, str(path))
+    exported = attribute_trace(str(path))
+    assert (
+        [s.name for s in exported.critical_path]
+        == [s.name for s in live.critical_path]
+    )
+    assert exported.makespan_s == pytest.approx(live.makespan_s, abs=1e-6)
+    for k, v in live.breakdown_s.items():
+        assert exported.breakdown_s[k] == pytest.approx(v, abs=1e-6)
+    assert exported.stragglers == live.stragglers
+    # loaded-dict form attributes identically to the path form
+    with open(path) as f:
+        again = attribute_trace(json.load(f))
+    assert again.summary()["critical_path"] == exported.summary()["critical_path"]
+
+
+def test_attribution_empty_and_windowed():
+    # no device spans: empty verdict, no crash, zero fractions
+    att = attribute_run(Tracer(clock=FakeClock(0.0)))
+    assert att.critical_path == [] and att.makespan_s == 0.0
+    assert sum(att.fractions().values()) == 0.0
+
+    # an explicit window clips the walk: only task_c fits in [4, 9], its
+    # wait back to the window start binds to the (still-included) flow
+    att2 = attribute_run(_doctor_tracer(), window=(4.0, 9.0))
+    assert [s.name for s in att2.critical_path] == ["task_c"]
+    assert att2.makespan_s == pytest.approx(4.0)
+    assert att2.breakdown_s["compute"] == pytest.approx(3.0)
+    assert att2.breakdown_s["transfer"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Cost-model drift
+
+
+def _drift_fixture():
+    from distributed_llm_scheduler_tpu.core.schedule import Schedule, TaskTiming
+
+    g = TaskGraph([
+        Task("a", 0.1, 1.0, [], set()),
+        Task("b", 0.1, 2.0, ["a"], set()),
+    ])
+    s = Schedule(policy="manual", per_node={"n0": ["a", "b"]},
+                 assignment_order=["a", "b"], completed={"a", "b"})
+    s.timings = {
+        "a": TaskTiming("a", "n0", 0.0, 2.0),  # measured 2.0 vs predicted 1.0
+        "b": TaskTiming("b", "n0", 2.0, 3.0),  # measured 1.0 vs predicted 2.0
+    }
+    return g, s
+
+
+def test_drift_report_math_exact():
+    g, s = _drift_fixture()
+    rep = compute_drift(g, s)
+    assert rep.source == "compute_time"
+    assert {t.task_id: t.ratio for t in rep.tasks} == {"a": 2.0, "b": 0.5}
+    # two-sided worst: the 2x underestimate and the 2x overestimate tie
+    assert rep.worst_ratio() == pytest.approx(2.0)
+    assert rep.exceeds(1.5)
+    assert not rep.exceeds(2.5) and not rep.exceeds(None)
+    assert rep.measured_makespan_s == pytest.approx(3.0)
+    # predicted: the same chain replayed under compute_time = 1 + 2
+    assert rep.predicted_makespan_s == pytest.approx(3.0)
+    assert rep.makespan_ratio == pytest.approx(1.0)
+    assert rep.per_class["a"]["median_ratio"] == pytest.approx(2.0)
+    assert rep.per_class["b"]["measured_s"] == pytest.approx(1.0)
+    # |log ratio| ranking lists both equally-wrong tasks
+    assert {t.task_id for t in rep.worst} == {"a", "b"}
+    summ = json.loads(json.dumps(rep.summary()))
+    assert summ["n_tasks"] == 2 and summ["worst_ratio"] == pytest.approx(2.0)
+
+
+def test_drift_uses_cost_model_and_never_mutates_graph():
+    from distributed_llm_scheduler_tpu.utils.costmodel import CostModel
+
+    g, s = _drift_fixture()
+    cm = CostModel(
+        graph_name="fixture", platform="cpu",
+        task_seconds={"a": 4.0, "b": 1.0}, method="profile",
+    )
+    rep = compute_drift(g, s, cm)
+    assert rep.source == "profile"
+    assert {t.task_id: t.ratio for t in rep.tasks} == {"a": 0.5, "b": 1.0}
+    # the predicted-makespan simulation swapped 4.0/1.0 in and back out
+    assert rep.predicted_makespan_s == pytest.approx(5.0)
+    assert g["a"].compute_time == 1.0 and g["b"].compute_time == 2.0
+    # skip rule: non-positive predictions drop the task from the ratios
+    cm0 = CostModel(
+        graph_name="fixture", platform="cpu",
+        task_seconds={"a": 0.0, "b": 1.0}, method="profile",
+    )
+    rep0 = compute_drift(g, s, cm0)
+    assert [t.task_id for t in rep0.tasks] == ["b"]
