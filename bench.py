@@ -307,9 +307,21 @@ def measure(
     # hides everything.
     from distributed_llm_scheduler_tpu.utils.costmodel import repeat_capture
 
+    # fence-RTT calibration, ONCE, before any repeat leg: execute()
+    # re-probed the RTT inside every window of every leg (~5 fence
+    # round-trips each — the r05 artifact's 70.6 ms fence_rtt_ms dwarfs
+    # the ~10-25 ms programs being measured), so the probes dominated
+    # leg wall time and each window corrected with a different draw.
+    # One calibration shared across all legs of this session, reported
+    # once in the artifact (fence_rtt_ms).
+    from distributed_llm_scheduler_tpu.utils.costmodel import _fence_rtt
+
+    rtt = _fence_rtt(devices[0])
+
     spread: dict = {}
     pt_reports = repeat_capture(lambda: backend.execute(
-        graph, sched_one, params, ids, warmup=False, reps=pt_reps
+        graph, sched_one, params, ids, warmup=False, reps=pt_reps,
+        fence_rtt=rtt,
     ), 3)
     pt_samples = [r.makespan_s for r in pt_reports]
     pt_makespan = statistics.median(pt_samples)
@@ -328,13 +340,11 @@ def measure(
     # axon tunnel (utils/costmodel.readback_fence) — queue K forwards and
     # force completion with one readback, netting out the fence round-trip
     from distributed_llm_scheduler_tpu.utils.costmodel import (
-        _fence_rtt,
         readback_fence,
         time_amortized,
     )
 
-    readback_fence(fused)
-    rtt = _fence_rtt(devices[0])
+    readback_fence(fused)  # rtt already calibrated above, shared per-leg
     # time a scalar-reduced composition: the raw logits output is ~400 MB,
     # which caps amortization at ~2 reps and makes the measurement swing
     # 2x run-to-run through the tunnel.  jnp.sum fuses into the compiled
@@ -426,7 +436,7 @@ def measure(
         # window-scale throughput dips (see fused_scalar_samples)
         seg_samples = repeat_capture(lambda: backend.execute(
             graph, sched_one, params, ids, segments=True,
-            warmup=False, reps=seg_reps,
+            warmup=False, reps=seg_reps, fence_rtt=rtt,
         ).makespan_s, 3)
         seg_makespan = statistics.median(seg_samples)
         spread["segmented"] = spread_stats(seg_samples)
@@ -441,6 +451,49 @@ def measure(
 
         log("bench: WARNING segment-fused execution failed (per-task "
             "numbers still valid):\n" + traceback.format_exc())
+    # whole-program compiled execution: the entire scheduled run lowered
+    # into ONE launch (backends/compiled_schedule.py) — the last rung of
+    # the dispatch ladder; host work per run is O(devices), not O(tasks)
+    comp_makespan = comp_mfu = comp_overhead_ms = None
+    try:
+        crep = backend.execute(
+            graph, sched_one, params, ids, compiled=True, fence_rtt=rtt,
+        )
+        comp_oracle = oracle_close(fused, crep.output, dtype_name_oracle)
+        comp_samples = repeat_capture(lambda: backend.execute(
+            graph, sched_one, params, ids, compiled=True,
+            warmup=False, reps=seg_reps, fence_rtt=rtt,
+        ), 3)
+        comp_makespan = statistics.median(
+            [r.makespan_s for r in comp_samples]
+        )
+        # dispatch wall from single-rep runs: re-enqueueing the same
+        # executable while its previous execution is in flight blocks
+        # the host (CPU PJRT at least), so the multi-rep samples above
+        # would report device compute as "dispatch".  Each single-rep
+        # run fences, so every launch below is a clean enqueue.
+        comp_overhead_ms = statistics.median(repeat_capture(
+            lambda: backend.execute(
+                graph, sched_one, params, ids, compiled=True,
+                warmup=False, reps=1, fence_rtt=rtt,
+            ).dispatch_overhead_s, 3,
+        )) * 1e3
+        spread["compiled"] = spread_stats(
+            [r.makespan_s for r in comp_samples]
+        )
+        comp_mfu = compute_mfu(flops, comp_makespan, platform, dtype_name)
+        log(f"bench: whole-program compiled makespan "
+            f"{comp_makespan*1e3:.2f} ms ({crep.n_dispatches} launches, "
+            f"dispatch wall {comp_overhead_ms:.2f} ms/rep); "
+            f"matches fused: {comp_oracle}"
+            + (f"; MFU {comp_mfu:.1%}" if comp_mfu is not None else ""))
+        oracle_ok = oracle_ok and comp_oracle
+    except Exception:
+        import traceback
+
+        log("bench: WARNING whole-program compiled execution failed "
+            "(per-task/segmented numbers still valid):\n"
+            + traceback.format_exc())
     if mfu is not None:
         log(f"bench: single-chip MFU {mfu:.1%} "
             f"({flops/1e12:.2f} TFLOP over {pt_makespan*1e3:.2f} ms)")
@@ -554,6 +607,9 @@ def measure(
         link_provenance=link_prov,
         segmented_makespan_s=seg_makespan,
         mfu_segmented=seg_mfu,
+        compiled_makespan_s=comp_makespan,
+        mfu_compiled=comp_mfu,
+        compiled_dispatch_overhead_ms=comp_overhead_ms,
         fused_forward_s=fused_like_s,
         fused_scalar_s=fused_wall_s,
         fence_rtt_s=rtt,

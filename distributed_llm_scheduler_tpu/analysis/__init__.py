@@ -30,6 +30,11 @@ from .diagnostics import (
     Diagnostic,
     Severity,
 )
+from .collective_pass import (
+    analyze_collectives,
+    analyze_collectives_jaxpr,
+    analyze_schedule_lowerability,
+)
 from .cost_pass import analyze_cost
 from .decode_pass import analyze_decode
 from .fixes import fix_duplicate_dependencies, fix_per_node_order
@@ -47,8 +52,11 @@ __all__ = [
     "Diagnostic",
     "Severity",
     "analyze",
+    "analyze_collectives",
+    "analyze_collectives_jaxpr",
     "analyze_cost",
     "analyze_decode",
+    "analyze_schedule_lowerability",
     "analyze_graph",
     "analyze_memory",
     "analyze_pipeline",
@@ -138,12 +146,19 @@ def pre_execution_gate(
     cluster: Cluster,
     schedule: Schedule,
     backend: str = "sim",
+    program: Optional[Any] = None,
 ) -> Optional[AnalysisReport]:
     """Cheap (O(V+E)) corruption check run by the backends before work.
 
     Raises :class:`AnalysisError` when the schedule would corrupt this
     backend's execution; returns the (possibly empty) report otherwise,
     or ``None`` when the gate is disabled via ``DLS_SKIP_ANALYSIS``.
+
+    ``program`` (compiled execution path): the lowered
+    :class:`..sched.linearize.ProgramIR` — the collective-ordering pass
+    then joins the gate (COL001 divergent sequences, COL004 malformed
+    permutations; COL002 deadlocks surface earlier, at linearization,
+    because without a global order there is no program to pass here).
     """
     if not gate_enabled():
         return None
@@ -151,6 +166,9 @@ def pre_execution_gate(
     rep = analyze_graph(graph)
     rep.extend(analyze_decode(graph, cluster, schedule))
     rep.extend(analyze_schedule(graph, cluster, schedule))
+    if program is not None:
+        rep.extend(analyze_collectives(program))
+        codes = codes | {"COL001", "COL002", "COL004"}
     if backend == "sim":
         rep.extend(analyze_pipeline(graph, schedule))
         # the replay indexes placement[tid] for every ordered task

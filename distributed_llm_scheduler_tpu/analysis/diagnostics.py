@@ -77,6 +77,11 @@ CODES: Dict[str, str] = {
     "CST001": "analytic memory estimate under-predicts XLA preflight",
     "CST002": "analytic memory estimate over-predicts XLA preflight",
     "CST003": "task missing from XLA preflight measurement",
+    # -- collective ordering (collective_pass) --------------------------
+    "COL001": "devices would issue divergent collective sequences",
+    "COL002": "per-node orders deadlock: no valid global collective order",
+    "COL003": "collective sequence diverges across control-flow branches",
+    "COL004": "collective permutation is not a valid partial permutation",
 }
 
 

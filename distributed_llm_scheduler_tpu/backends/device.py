@@ -79,6 +79,10 @@ class DeviceReport:
     dispatch_phases: Dict[str, float] = field(default_factory=dict)
     # True when the run used the pre-planned fast path (dispatch_plan)
     planned: bool = False
+    # True when the run used the whole-program compiled path
+    # (compiled_schedule): ONE launch per run, cross-device edges as
+    # in-program collectives
+    compiled: bool = False
     # execute(keep_outputs=True): per-task outputs retained for elastic
     # recovery (every executed task per-task; segment exports under
     # segment fusion).  Keys feed reschedule()/execute(ext_outputs=...)
@@ -120,6 +124,7 @@ class DeviceReport:
                 k: v * 1e3 for k, v in self.dispatch_phases.items()
             },
             "planned": self.planned,
+            "compiled": self.compiled,
             "peak_hbm_gb": {
                 k: v / 1024**3 for k, v in self.peak_hbm_bytes.items()
             },
@@ -193,6 +198,14 @@ class DeviceBackend:
         # graph -> {(tids, exports, donate_argnums): jitted coalesced
         # launch group} (dispatch_plan coalescing); weak like _seg_cache
         self._group_cache: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+        # graph -> {program signature: jitted whole-program callable}
+        # (compiled_schedule); the signature pins every structural input
+        # (IR, slab layout, input avals, donation), so repeated executes
+        # of one schedule reuse the XLA executable while slabs restage
+        # from the CURRENT params
+        self._prog_cache: "weakref.WeakKeyDictionary" = (
             weakref.WeakKeyDictionary()
         )
         # cumulative jit-cache hit/miss counts across every cache above;
@@ -1288,6 +1301,8 @@ class DeviceBackend:
         planned: Optional[bool] = None,
         coalesce: bool = False,
         donate: Optional[bool] = None,
+        compiled: bool = False,
+        fence_rtt: Optional[float] = None,
         trace: Any = None,
         metrics: Any = None,
     ) -> DeviceReport:
@@ -1304,6 +1319,26 @@ class DeviceBackend:
         legacy paths.  Placement, dispatch order, transfer counting, and
         the end-of-run fence are identical to the legacy loop; outputs
         are bit-identical.
+
+        ``compiled`` selects the whole-program path
+        (:mod:`.compiled_schedule`): the entire placed run lowers into
+        ONE jitted program (per-device compute under a ``lax.switch``
+        over the mesh index, cross-device edges as in-program
+        ``ppermute`` collectives), so the host issues one staging put
+        per input leaf plus a single launch per run.  Outputs stay
+        bit-identical to the interpreted paths (per-task
+        ``optimization_barrier`` islands).  Lowering runs the COL00x
+        collective-ordering gate; a schedule whose per-node orders admit
+        no global collective order raises (COL002) instead of silently
+        re-linearizing.  Incompatible with every per-task feature
+        (``profile``/``stream_params``/``segments``/``coalesce``/
+        ``keep_outputs``/``ext_outputs``) — see docs/ARCHITECTURE.md's
+        execution ladder for when to pick which rung.
+
+        ``fence_rtt`` supplies a pre-calibrated fence round-trip
+        (seconds) instead of re-probing it inside this call — callers
+        timing several executes back-to-back (bench repeat legs)
+        calibrate once and share it.
 
         ``donate`` (planned only): donate intermediate buffers that die
         after their last same-device consumer via ``donate_argnums``.
@@ -1393,6 +1428,25 @@ class DeviceBackend:
             raise ValueError(
                 "profile=True needs per-task dispatch; run without segments"
             )
+        if compiled:
+            # the whole run is ONE XLA program: there are no per-task
+            # boundaries to time/stream/retain, no host-mediated segments,
+            # and external values would have to be program inputs
+            incompatible = [
+                name for name, flag in (
+                    ("profile", profile), ("stream_params", stream_params),
+                    ("segments", segments), ("coalesce", coalesce),
+                    ("keep_outputs", keep_outputs),
+                    ("ext_outputs", ext_outputs is not None),
+                    ("planned", bool(planned)),
+                ) if flag
+            ]
+            if incompatible:
+                raise ValueError(
+                    "compiled=True lowers the whole run into one program "
+                    f"and is incompatible with {incompatible}"
+                )
+            planned = False
         if planned is None:
             planned = not (profile or stream_params or segments)
         elif planned and (profile or stream_params or segments):
@@ -1408,13 +1462,15 @@ class DeviceBackend:
                 "donate=True deletes dying intermediates; keep_outputs "
                 "must retain them — drop one of the two"
             )
-        if planned:
+        if planned or compiled:
             from .dispatch_plan import donation_supported
 
             if donate is None:
                 donate = donation_supported() and not keep_outputs
         elif donate:
-            raise ValueError("donate=True requires the planned path")
+            raise ValueError(
+                "donate=True requires the planned or compiled path"
+            )
         else:
             donate = False
         if reps < 1:
@@ -1425,7 +1481,9 @@ class DeviceBackend:
                 "mode fences per task and stream_params runs must start "
                 "cold — measure those with reps=1"
             )
-        if self.pre_analysis:
+        if self.pre_analysis and not compiled:
+            # the compiled path gates inside CompiledSchedule.build with
+            # the lowered program attached (COL00x joins the checks)
             from ..analysis import pre_execution_gate
 
             pre_execution_gate(
@@ -1459,13 +1517,15 @@ class DeviceBackend:
         # one linearization for the stream plan, the segment build, and
         # every rep: dispatch_order is a pure function of (graph,
         # schedule) and costs ~ms on 500-task DAGs
-        t_ph = time.perf_counter() if tracer is not None else 0.0
-        order_once = self.dispatch_order(graph, schedule)
-        if tracer is not None:
-            tracer.complete(
-                "dispatch_order", t_ph, time.perf_counter(),
-                track="host", cat="schedule", tasks=len(order_once),
-            )
+        order_once: List[str] = []
+        if not compiled:
+            t_ph = time.perf_counter() if tracer is not None else 0.0
+            order_once = self.dispatch_order(graph, schedule)
+            if tracer is not None:
+                tracer.complete(
+                    "dispatch_order", t_ph, time.perf_counter(),
+                    track="host", cat="schedule", tasks=len(order_once),
+                )
         segments_pre = None
         if stream_params:
             placed, bytes_per_node = {}, {d.node_id: 0 for d in self.cluster}
@@ -1496,6 +1556,10 @@ class DeviceBackend:
                     stream_plan.setdefault(node, []).append(
                         (tid, tuple(g for _, g in graph[tid].param_items()))
                     )
+        elif compiled:
+            # the compiled path loads params as sharded slabs inside
+            # CompiledSchedule.build — per-global placement never happens
+            placed, bytes_per_node = {}, {}
         else:
             t_ph = time.perf_counter() if tracer is not None else 0.0
             placed, bytes_per_node = self.place_params(graph, schedule, params)
@@ -1517,7 +1581,24 @@ class DeviceBackend:
         # slot-indexed staging, donation patterns) so the timed loop does
         # no per-task bookkeeping at all
         plan = None
-        if planned:
+        prog = None
+        if compiled:
+            from .compiled_schedule import CompiledSchedule
+
+            t_ph = time.perf_counter() if tracer is not None else 0.0
+            prog = CompiledSchedule.build(
+                self, graph, schedule, params, graph_input,
+                donate=donate, pre_analysis=self.pre_analysis,
+            )
+            bytes_per_node = prog.param_bytes_per_node
+            if tracer is not None:
+                tracer.complete(
+                    "program_build", t_ph, time.perf_counter(),
+                    track="host", cat="plan",
+                    phases=len(prog.ir.phases),
+                    exchanges=prog.ir.n_exchanges,
+                )
+        elif planned:
             from .dispatch_plan import DispatchPlan
 
             t_ph = time.perf_counter() if tracer is not None else 0.0
@@ -1536,7 +1617,18 @@ class DeviceBackend:
         compile_s = 0.0
         if warmup:
             t_ph = time.perf_counter() if tracer is not None else 0.0
-            if plan is not None:
+            if prog is not None:
+                # first run traces + XLA-compiles the whole-program
+                # executable; same donation-warning note as the plan path
+                t0 = time.perf_counter()
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable",
+                    )
+                    prog.run(graph_input, fence=True)
+                compile_s = time.perf_counter() - t0
+            elif plan is not None:
                 # one full planned execution: jits every resolved
                 # executable (donating variants and coalesced groups
                 # included) and fills the static transfer-byte table.
@@ -1580,10 +1672,16 @@ class DeviceBackend:
         # fence round-trip, re-measured per execute (outside the timed
         # region): tunnel RTT demonstrably changes across reconnects, so a
         # backend-lifetime cache would correct post-reconnect runs with a
-        # stale value and bias cross-policy comparisons
-        from ..utils.costmodel import _fence_rtt
+        # stale value and bias cross-policy comparisons.  Callers timing
+        # several executes back-to-back (bench repeat legs) pass a shared
+        # ``fence_rtt`` calibrated once: the ~5-sample probe costs several
+        # RTTs per call and would otherwise dwarf short measured programs
+        if fence_rtt is not None:
+            rtt = fence_rtt
+        else:
+            from ..utils.costmodel import _fence_rtt
 
-        rtt = _fence_rtt(self._fence_device())
+            rtt = _fence_rtt(self._fence_device())
 
         streamer = (
             self._ParamStreamer(
@@ -1598,7 +1696,14 @@ class DeviceBackend:
         for r in range(reps):
             fence = r == reps - 1  # intermediate reps queue without fencing
             t_ph = time.perf_counter() if tracer is not None else 0.0
-            if plan is not None:
+            if prog is not None:
+                (
+                    output, timings, tedges, tbytes, n_fences, n_disp,
+                    touts, phases,
+                ) = prog.run(
+                    graph_input, fence=fence, tracer=tracer, metrics=mreg,
+                )
+            elif plan is not None:
                 (
                     output, timings, tedges, tbytes, n_fences, n_disp,
                     touts, phases,
@@ -1711,6 +1816,7 @@ class DeviceBackend:
             dispatch_overhead_s=dispatch_overhead_s,
             dispatch_phases=dispatch_phases,
             planned=plan is not None,
+            compiled=prog is not None,
             task_outputs=touts if keep_outputs else {},
             streamed=streamer is not None,
             param_loads=streamer.loads if streamer else 0,

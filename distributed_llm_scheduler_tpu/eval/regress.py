@@ -30,6 +30,8 @@ from typing import Any, Dict, List, Optional, Sequence
 LOWER_BETTER = (
     "value",                  # headline makespan (ms)
     "segmented_makespan_ms",
+    "compiled_makespan_ms",
+    "compiled_dispatch_overhead_ms",
     "fused_forward_ms",
     "fused_scalar_ms",
     "dispatch_overhead",
@@ -41,6 +43,7 @@ HIGHER_BETTER = (
     "vs_baseline",
     "mfu_single_chip",
     "mfu_segmented",
+    "mfu_compiled",
 )
 BOOL_METRICS = ("oracle_ok",)
 
@@ -51,10 +54,12 @@ DEFAULT_METRICS = (
     "value",
     "vs_baseline",
     "segmented_makespan_ms",
+    "compiled_makespan_ms",
     "dispatch_overhead",
     "peak_hbm_gb_modeled",
     "mfu_single_chip",
     "mfu_segmented",
+    "mfu_compiled",
     "oracle_ok",
 )
 

@@ -1,0 +1,326 @@
+"""Per-device linearization of a placed schedule into a phase/exchange IR.
+
+The compiled execution path (backends/compiled_schedule.py) lowers each
+device's ENTIRE scheduled run into one XLA program, with cross-device
+edges expressed as in-program collectives.  Collectives are rendezvous
+points: every participating device must issue the same collective in the
+same position of its program, or the mesh deadlocks — so the lowering
+cannot reuse :meth:`DeviceBackend.dispatch_order`'s silent topological
+fallback (harmless for host-mediated transfers, fatal once the transfer
+is a ``ppermute`` both sides must reach).  This module produces the
+intermediate representation the lowering and the COL00x analysis pass
+(analysis/collective_pass.py) share:
+
+* :func:`strict_dispatch_order` — the same greedy per-node-order merge as
+  the interpreted path, but a cross-node ordering cycle raises
+  :class:`OrderingDeadlock` (carrying the stuck queue heads) instead of
+  silently re-linearizing;
+* :func:`linearize` — cuts that global order into **phases** (per-device
+  compute blocks separated by cross-device exchanges): a task lands in
+  the earliest phase after every cross-device producer has been
+  exchanged, never earlier than its same-device predecessor in the
+  schedule's per-node order.  Phase boundaries carry the ordered
+  :class:`Exchange` list — each lowered as one ``ppermute`` over the mesh
+  axis, emitted identically on every device (SPMD), which is what makes
+  the global collective order deadlock-free by construction.
+
+The IR is deliberately tiny and pure-Python: the analysis pass verifies
+properties on it (identical per-device collective sequences, permutation
+validity) without tracing any JAX, and :meth:`ProgramIR.signature` gives
+the deterministic identity the compiled-program cache keys off.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.graph import TaskGraph
+from ..core.schedule import Schedule
+
+
+class OrderingDeadlock(RuntimeError):
+    """Per-node orders are mutually inconsistent: the greedy merge stalled
+    with every queue head waiting on a task stuck behind another head.
+
+    ``heads`` maps each stalled node to its blocking queue head and the
+    unmet dependencies that head is waiting for.
+    """
+
+    def __init__(self, heads: Dict[str, Tuple[str, Tuple[str, ...]]]):
+        self.heads = dict(heads)
+        detail = "; ".join(
+            f"{node}: {tid!r} waits on {list(deps)}"
+            for node, (tid, deps) in sorted(self.heads.items())
+        )
+        super().__init__(
+            f"per-node orders admit no global dispatch order ({detail})"
+        )
+
+
+def strict_dispatch_order(
+    graph: TaskGraph, schedule: Schedule
+) -> List[str]:
+    """Global linearization honoring per-node order — or a hard error.
+
+    Identical greedy merge to ``DeviceBackend.dispatch_order`` (emit the
+    earliest-assigned ready queue head), except that a stall raises
+    :class:`OrderingDeadlock` rather than falling back to topological
+    order: a compiled program built from a re-linearized order would run,
+    but its collective sequence would no longer be the schedule the
+    policy decided — and in a true MPMD deployment the divergence is a
+    deadlock, so it must surface as an error here (COL002).
+    """
+    placement = schedule.placement
+    topo_pos = {tid: i for i, tid in enumerate(graph.topo_order)}
+    prio = {tid: i for i, tid in enumerate(schedule.assignment_order)}
+    queues = {
+        n: [t for t in lst if t in topo_pos and placement.get(t) == n]
+        for n, lst in schedule.per_node.items()
+        if lst
+    }
+    queues = {n: q for n, q in queues.items() if q}
+    idx = {n: 0 for n in queues}
+    emitted: set = set()
+    order: List[str] = []
+
+    def unmet(t: str) -> Tuple[str, ...]:
+        return tuple(
+            d for d in graph[t].dependencies
+            if d not in emitted and d in placement
+        )
+
+    total = sum(len(q) for q in queues.values())
+    while len(order) < total:
+        ready = [
+            n for n in queues
+            if idx[n] < len(queues[n]) and not unmet(queues[n][idx[n]])
+        ]
+        if not ready:
+            heads = {
+                n: (queues[n][idx[n]], unmet(queues[n][idx[n]]))
+                for n in queues
+                if idx[n] < len(queues[n])
+            }
+            raise OrderingDeadlock(heads)
+        n = min(
+            ready,
+            key=lambda n: (
+                prio.get(queues[n][idx[n]], topo_pos[queues[n][idx[n]]]),
+                topo_pos[queues[n][idx[n]]],
+            ),
+        )
+        t = queues[n][idx[n]]
+        idx[n] += 1
+        emitted.add(t)
+        order.append(t)
+    return order
+
+
+@dataclass(frozen=True)
+class Exchange:
+    """One cross-device value movement at a phase boundary: the value of
+    ``tid`` (computed on ``src``) becomes available on ``dst``.  Lowered
+    as one ``lax.ppermute`` with ``perm=((src_index, dst_index),)``."""
+
+    tid: str
+    src: str
+    dst: str
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One compute block: every device runs its ``compute`` tasks (in
+    per-node schedule order), then all devices issue ``exchanges`` in
+    listed order."""
+
+    index: int
+    compute: Dict[str, Tuple[str, ...]]
+    exchanges: Tuple[Exchange, ...]
+
+
+@dataclass(frozen=True)
+class ProgramIR:
+    """The whole-program lowering plan: devices in mesh order, the global
+    linearization, and the phase/exchange alternation."""
+
+    devices: Tuple[str, ...]
+    order: Tuple[str, ...]
+    phases: Tuple[Phase, ...]
+    #: tasks whose values must survive their producing phase (consumed in
+    #: a later phase, exchanged, per-device last, or the graph's final)
+    live_out: Dict[int, Tuple[str, ...]] = field(default_factory=dict)
+
+    @property
+    def device_index(self) -> Dict[str, int]:
+        return {d: i for i, d in enumerate(self.devices)}
+
+    def collective_sequence(
+        self, device: Optional[str] = None
+    ) -> List[Tuple[str, Tuple[Tuple[int, int], ...], str]]:
+        """The ordered collective ops the lowered program issues, as
+        ``(primitive, perm, value_id)`` tuples.
+
+        SPMD lowering emits every exchange on every device, so the
+        sequence is device-independent — which is exactly the property
+        the COL001 check verifies by comparing this per device.
+        ``device`` is accepted so a corrupted/mocked IR (tests, future
+        true-MPMD lowerings) can expose per-device divergence.
+        """
+        del device  # SPMD: identical everywhere, by construction
+        dix = self.device_index
+        seq = []
+        for ph in self.phases:
+            for ex in ph.exchanges:
+                seq.append(
+                    ("ppermute", ((dix[ex.src], dix[ex.dst]),), ex.tid)
+                )
+        return seq
+
+    def signature(self) -> Tuple:
+        """Hashable structural identity: equal signatures lower to the
+        same program (deterministic-lowering contract)."""
+        return (
+            self.devices,
+            self.order,
+            tuple(
+                (
+                    ph.index,
+                    tuple(sorted(
+                        (n, ts) for n, ts in ph.compute.items()
+                    )),
+                    ph.exchanges,
+                )
+                for ph in self.phases
+            ),
+        )
+
+    @property
+    def n_exchanges(self) -> int:
+        return sum(len(ph.exchanges) for ph in self.phases)
+
+
+def linearize(
+    graph: TaskGraph,
+    schedule: Schedule,
+    order: Optional[Sequence[str]] = None,
+    device_order: Optional[Sequence[str]] = None,
+) -> ProgramIR:
+    """Cut a verified global order into the phase/exchange IR.
+
+    ``order`` defaults to :func:`strict_dispatch_order` (raising
+    :class:`OrderingDeadlock` on inconsistent per-node orders).  Tasks
+    with unplaced (or transitively skipped) producers are dropped, like
+    every execution path.  ``device_order`` fixes the mesh axis order
+    (defaults to first-appearance order of nodes in the schedule's
+    cluster iteration — callers pass the cluster's device order so mesh
+    index == cluster index).
+
+    Phase assignment: ``phase(t) = max(phase(same-device deps),
+    phase(cross-device deps) + 1, phase(previous task on t's device))``.
+    Each cross-device edge becomes an :class:`Exchange` at the boundary
+    just before its consumer's phase, deduplicated per (value, dst) to
+    the earliest consumer (received values persist in the consumer's
+    registers).  Exchange order within a boundary is deterministic:
+    producer's global-order position, then destination mesh index.
+    """
+    placement = schedule.placement
+    if order is None:
+        order = strict_dispatch_order(graph, schedule)
+    # drop tasks whose transitive producers never run (fail-and-continue,
+    # same filter as the segmented runner)
+    alive: set = set()
+    kept: List[str] = []
+    for tid in order:
+        if tid not in placement:
+            continue
+        aids = graph[tid].arg_tasks or graph[tid].dependencies
+        if all(d in alive for d in aids):
+            alive.add(tid)
+            kept.append(tid)
+    order = kept
+
+    if device_order is None:
+        seen: Dict[str, None] = {}
+        for tid in order:
+            seen.setdefault(placement[tid])
+        devices = tuple(seen)
+    else:
+        used = {placement[t] for t in order}
+        devices = tuple(d for d in device_order if d in used)
+
+    opos = {t: i for i, t in enumerate(order)}
+    dix = {d: i for i, d in enumerate(devices)}
+    phase_of: Dict[str, int] = {}
+    last_on: Dict[str, int] = {}
+    for tid in order:
+        node = placement[tid]
+        p = last_on.get(node, 0)
+        for d in graph[tid].arg_tasks or graph[tid].dependencies:
+            if d not in phase_of:
+                continue  # graph input / ext value: phase 0 is fine
+            if placement[d] == node:
+                p = max(p, phase_of[d])
+            else:
+                p = max(p, phase_of[d] + 1)
+        phase_of[tid] = p
+        last_on[node] = p
+
+    n_phases = (max(phase_of.values()) + 1) if phase_of else 0
+    compute: List[Dict[str, List[str]]] = [{} for _ in range(n_phases)]
+    for tid in order:
+        compute[phase_of[tid]].setdefault(placement[tid], []).append(tid)
+
+    # one exchange per (value, dst), at the earliest consuming boundary
+    first_need: Dict[Tuple[str, str], int] = {}
+    for tid in order:
+        node = placement[tid]
+        for d in graph[tid].arg_tasks or graph[tid].dependencies:
+            if d in phase_of and placement[d] != node:
+                key = (d, node)
+                b = phase_of[tid] - 1
+                if key not in first_need or b < first_need[key]:
+                    first_need[key] = b
+    exchanges: List[List[Exchange]] = [[] for _ in range(n_phases)]
+    for (val, dst), b in first_need.items():
+        exchanges[b].append(Exchange(val, placement[val], dst))
+    for b in range(n_phases):
+        exchanges[b].sort(key=lambda ex: (opos[ex.tid], dix[ex.dst]))
+
+    # liveness: a phase must export values consumed after it, exchanged
+    # at-or-after its boundary, each device's final value (the fence
+    # tip), and the graph's final output
+    last_tid = {d: None for d in devices}
+    for tid in order:
+        last_tid[placement[tid]] = tid
+    keep: set = set(t for t in last_tid.values() if t)
+    if graph.topo_order and graph.topo_order[-1] in phase_of:
+        keep.add(graph.topo_order[-1])
+    needed_later: set = set(keep)
+    for tid in order:
+        for d in graph[tid].arg_tasks or graph[tid].dependencies:
+            if d in phase_of and phase_of[d] < phase_of[tid]:
+                needed_later.add(d)
+    for exs in exchanges:
+        for ex in exs:
+            needed_later.add(ex.tid)
+    live_out = {
+        p: tuple(
+            t for t in order
+            if t in needed_later and phase_of[t] == p
+        )
+        for p in range(n_phases)
+    }
+
+    phases = tuple(
+        Phase(
+            index=p,
+            compute={n: tuple(ts) for n, ts in compute[p].items()},
+            exchanges=tuple(exchanges[p]),
+        )
+        for p in range(n_phases)
+    )
+    return ProgramIR(
+        devices=devices, order=tuple(order), phases=phases,
+        live_out=live_out,
+    )

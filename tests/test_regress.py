@@ -22,10 +22,12 @@ BASE = {
     "value": 100.0,
     "vs_baseline": 1.5,
     "segmented_makespan_ms": 80.0,
+    "compiled_makespan_ms": 75.0,
     "dispatch_overhead": 0.2,
     "peak_hbm_gb_modeled": 4.0,
     "mfu_single_chip": 0.30,
     "mfu_segmented": 0.25,
+    "mfu_compiled": 0.28,
     "oracle_ok": True,
 }
 
