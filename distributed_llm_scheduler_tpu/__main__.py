@@ -180,6 +180,28 @@ def cmd_lint(args) -> int:
     from .analysis import _spec_shapes, analyze
     from .parallel.mesh import factorize_mesh
 
+    if args.parallel:
+        if args.decode or args.paged or args.preflight or args.fix:
+            print("--parallel lints the hand-written parallel layer and "
+                  "combines only with --verbose", file=sys.stderr)
+            return 2
+        from .analysis import (
+            Severity,
+            analyze_happens_before,
+            stage_programs_1f1b,
+            sweep_parallel_collectives,
+        )
+
+        rep = sweep_parallel_collectives()
+        # self-check the MPMD model on the canonical clean schedule: any
+        # COL005/006/007 here means the 1F1B generator or the
+        # happens-before pass itself regressed
+        rep.extend(analyze_happens_before(stage_programs_1f1b(4, 8)))
+        rep = rep.dedupe()
+        min_sev = Severity.INFO if args.verbose else Severity.WARNING
+        print(rep.render(min_severity=min_sev))
+        return rep.exit_code
+
     cfg = _config_from(args)
     if args.decode and _weights_family(cfg.model) is None:
         print("--decode needs a real model family (gpt2*/llama*/mixtral*)",
@@ -1330,6 +1352,12 @@ def main(argv=None) -> int:
              "without executing (exit 1 on errors)",
     )
     _add_common(p)
+    p.add_argument("--parallel", action="store_true",
+                   help="sweep the hand-written parallel layer instead of "
+                        "a DAG: trace every registered entry point "
+                        "(parallel/*) and check collective ordering "
+                        "(COL003/COL004/COL008) plus the MPMD "
+                        "happens-before self-check (COL005-COL007)")
     p.add_argument("--decode", action="store_true",
                    help="lint the single-token decode-step DAG instead of "
                         "the full forward")

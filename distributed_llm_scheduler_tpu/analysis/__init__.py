@@ -37,9 +37,12 @@ from .collective_pass import (
 )
 from .cost_pass import analyze_cost
 from .decode_pass import analyze_decode
+from .donation_pass import analyze_donation
 from .fixes import fix_duplicate_dependencies, fix_per_node_order
 from .graph_pass import analyze_graph
+from .hb_pass import StageOp, analyze_happens_before, stage_programs_1f1b
 from .memory_pass import analyze_memory
+from .parallel_sweep import sweep_parallel_collectives
 from .pipeline_pass import analyze_pipeline
 from .quant_pass import analyze_quantization
 from .schedule_pass import analyze_schedule
@@ -51,11 +54,14 @@ __all__ = [
     "AnalysisReport",
     "Diagnostic",
     "Severity",
+    "StageOp",
     "analyze",
     "analyze_collectives",
     "analyze_collectives_jaxpr",
     "analyze_cost",
     "analyze_decode",
+    "analyze_donation",
+    "analyze_happens_before",
     "analyze_schedule_lowerability",
     "analyze_graph",
     "analyze_memory",
@@ -67,6 +73,8 @@ __all__ = [
     "fix_per_node_order",
     "gate_enabled",
     "pre_execution_gate",
+    "stage_programs_1f1b",
+    "sweep_parallel_collectives",
 ]
 
 #: Setting this env var to anything non-empty (and not "0") disables the
@@ -91,6 +99,8 @@ def analyze(
     param_specs: Optional[Dict[str, Any]] = None,
     compiled_gb: Optional[Dict[str, float]] = None,
     analytic_gb: Optional[Dict[str, float]] = None,
+    stage_programs: Optional[Dict[str, Any]] = None,
+    plan: Optional[Any] = None,
 ) -> AnalysisReport:
     """Run every pass the provided inputs make applicable.
 
@@ -99,7 +109,11 @@ def analyze(
     pass runs when ``param_shapes`` + ``mesh_axes`` are given; the
     quantization pass runs when ``param_specs`` is given; the cost pass
     runs when ``compiled_gb`` (an ``utils.hbm.preflight_task_memory``
-    result, with ``analytic_gb`` the pre-preflight snapshot) is given.
+    result, with ``analytic_gb`` the pre-preflight snapshot) is given;
+    the MPMD happens-before pass runs when ``stage_programs`` (per-stage
+    op sequences, see :mod:`.hb_pass`) is given; the donation pass runs
+    when ``plan`` (a DispatchPlan/CompiledSchedule or their metadata
+    dict, see :mod:`.donation_pass`) is given.
     """
     rep = analyze_graph(graph)
     rep.extend(analyze_decode(graph, cluster, schedule))
@@ -120,6 +134,10 @@ def analyze(
         rep.extend(analyze_quantization(graph, param_specs))
     if compiled_gb is not None:
         rep.extend(analyze_cost(graph, compiled_gb, analytic_gb))
+    if stage_programs is not None:
+        rep.extend(analyze_happens_before(stage_programs))
+    if plan is not None:
+        rep.extend(analyze_donation(plan))
     return rep
 
 
@@ -147,6 +165,8 @@ def pre_execution_gate(
     schedule: Schedule,
     backend: str = "sim",
     program: Optional[Any] = None,
+    plan: Optional[Any] = None,
+    stage_programs: Optional[Dict[str, Any]] = None,
 ) -> Optional[AnalysisReport]:
     """Cheap (O(V+E)) corruption check run by the backends before work.
 
@@ -159,6 +179,15 @@ def pre_execution_gate(
     then joins the gate (COL001 divergent sequences, COL004 malformed
     permutations; COL002 deadlocks surface earlier, at linearization,
     because without a global order there is no program to pass here).
+
+    ``plan`` (dispatch/compiled execution paths): a DispatchPlan,
+    CompiledSchedule, or their donation metadata — the donation-alias
+    pass joins the gate (DON001-DON003: a donated buffer read, donated
+    twice, or donated across a device boundary corrupts silently).
+
+    ``stage_programs`` (MPMD lowerings): per-stage op sequences — the
+    happens-before pass joins the gate (COL005 wait cycles, COL006
+    unmatched channel cardinality; COL007 is a warning and never gates).
     """
     if not gate_enabled():
         return None
@@ -169,6 +198,12 @@ def pre_execution_gate(
     if program is not None:
         rep.extend(analyze_collectives(program))
         codes = codes | {"COL001", "COL002", "COL004"}
+    if plan is not None:
+        rep.extend(analyze_donation(plan))
+        codes = codes | {"DON001", "DON002", "DON003"}
+    if stage_programs is not None:
+        rep.extend(analyze_happens_before(stage_programs))
+        codes = codes | {"COL005", "COL006"}
     if backend == "sim":
         rep.extend(analyze_pipeline(graph, schedule))
         # the replay indexes placement[tid] for every ordered task

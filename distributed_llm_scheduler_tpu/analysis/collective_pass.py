@@ -207,18 +207,39 @@ def _walk_jaxpr(jaxpr: Any, rep: AnalysisReport, where: str) -> List[Tuple]:
     return seq
 
 
+#: eqn params holding the primal jaxpr of a custom-derivative call
+#: (``custom_jvp_call``/``custom_vjp_call``; jax renamed both the
+#: primitive and the param across versions, so resolve by name first
+#: rather than trusting duck-typing alone — a collective wrapped in a
+#: custom-derivative rule must never be silently skipped)
+_CUSTOM_CALL_PARAMS = ("call_jaxpr", "fun_jaxpr")
+
+
 def _subjaxprs(eqn: Any):
-    for v in eqn.params.values():
+    """Sub-jaxprs of one eqn: scan/while bodies, pjit/shard_map programs,
+    and custom_jvp_call/custom_vjp_call primal jaxprs.  Each distinct
+    jaxpr yields once (the custom-call params are also reachable through
+    the generic duck-typed walk on some jax versions)."""
+    seen: set = set()
+
+    def emit(v):
         j = getattr(v, "jaxpr", None)
-        if j is not None and hasattr(j, "eqns"):
+        if j is None or not hasattr(j, "eqns"):
+            j = v if hasattr(v, "eqns") else None
+        if j is not None and id(j) not in seen:
+            seen.add(id(j))
             yield j
-        elif hasattr(v, "eqns"):
-            yield v
-        elif isinstance(v, (tuple, list)):
+
+    if eqn.primitive.name.startswith(("custom_jvp_call", "custom_vjp_call")):
+        for key in _CUSTOM_CALL_PARAMS:
+            v = eqn.params.get(key)
+            if v is not None:
+                yield from emit(v)
+    for v in eqn.params.values():
+        yield from emit(v)
+        if isinstance(v, (tuple, list)):
             for w in v:
-                j = getattr(w, "jaxpr", None)
-                if j is not None and hasattr(j, "eqns"):
-                    yield j
+                yield from emit(w)
 
 
 def analyze_collectives_jaxpr(

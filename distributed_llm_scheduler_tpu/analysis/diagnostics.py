@@ -82,6 +82,17 @@ CODES: Dict[str, str] = {
     "COL002": "per-node orders deadlock: no valid global collective order",
     "COL003": "collective sequence diverges across control-flow branches",
     "COL004": "collective permutation is not a valid partial permutation",
+    # -- MPMD happens-before model (hb_pass) ----------------------------
+    "COL005": "cross-stage wait cycle: guaranteed MPMD deadlock",
+    "COL006": "unmatched send/recv cardinality between pipeline stages",
+    "COL007": "interleaving serializes the pipeline steady state",
+    # -- parallel-strategy sweep (parallel_sweep) -----------------------
+    "COL008": "parallel entry point failed to trace",
+    # -- donation-alias races (donation_pass) ---------------------------
+    "DON001": "buffer read after its donating launch",
+    "DON002": "buffer donated more than once (aliased donation)",
+    "DON003": "donation crosses a transfer/collective boundary with a "
+              "remote reader",
 }
 
 
@@ -112,7 +123,9 @@ class Diagnostic:
             )
             if v is not None
         )
-        return f"{self.code} {self.severity}: {self.message}{where}"
+        n = self.data.get("occurrences", 1)
+        times = f" (x{n})" if n > 1 else ""
+        return f"{self.code} {self.severity}: {self.message}{where}{times}"
 
 
 class AnalysisError(ValueError):
@@ -150,6 +163,31 @@ class AnalysisReport:
     def extend(self, other: "AnalysisReport") -> "AnalysisReport":
         self.diagnostics.extend(other.diagnostics)
         return self
+
+    def dedupe(self) -> "AnalysisReport":
+        """Collapse repeated findings — same code, severity, message, and
+        provenance — into ONE diagnostic carrying an occurrence count
+        (``data["occurrences"]``, rendered as ``(xN)``).  Jaxpr walks over
+        scanned/unrolled loops re-emit the identical finding once per
+        iteration; the parallel sweep dedupes so lint output stays
+        readable.  Order of first occurrence is preserved."""
+        seen: Dict[tuple, Diagnostic] = {}
+        out = AnalysisReport()
+        for d in self.diagnostics:
+            key = (d.code, d.severity, d.message, d.task, d.node, d.param)
+            kept = seen.get(key)
+            if kept is None:
+                kept = Diagnostic(
+                    d.code, d.severity, d.message,
+                    task=d.task, node=d.node, param=d.param,
+                    data=dict(d.data),
+                )
+                kept.data["occurrences"] = 1
+                seen[key] = kept
+                out.diagnostics.append(kept)
+            else:
+                kept.data["occurrences"] += 1
+        return out
 
     @property
     def errors(self) -> List[Diagnostic]:

@@ -1,0 +1,59 @@
+"""Parallel-strategy collective sweep: COL003/COL004 over every
+hand-written parallel entry point, COL008 when a probe rots.
+
+The per-strategy modules under :mod:`..parallel` each export a
+``collective_probe(devices=None) -> (fn, example_avals)`` hook (see the
+registry :data:`..parallel.COLLECTIVE_ENTRY_POINTS`).  This sweep traces
+each probe abstractly with ``jax.make_jaxpr`` — ShapeDtypeStruct inputs,
+zero FLOPs, no mesh execution — and runs
+:func:`.collective_pass.analyze_collectives_jaxpr` over the jaxpr: the
+ring/pipeline ``ppermute`` schedules get COL004 permutation validity,
+``cond``/``switch`` branches get COL003 sequence agreement.
+
+A probe that raises (module drifted, signature changed, divisibility
+precondition broken by a config edit) is itself a finding: **COL008
+(error)** — otherwise a rotting probe would silently shrink coverage
+while CI stays green.  Wired into ``lint --parallel`` and the
+``lint-parallel`` CI job.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional, Sequence
+
+from .collective_pass import analyze_collectives_jaxpr
+from .diagnostics import AnalysisReport, Severity
+
+
+def sweep_parallel_collectives(
+    entries: Optional[Sequence[str]] = None,
+    devices: Optional[Sequence] = None,
+) -> AnalysisReport:
+    """Trace and check every registered parallel entry point.
+
+    ``entries`` defaults to the full registry
+    (:data:`..parallel.COLLECTIVE_ENTRY_POINTS`); ``devices`` defaults to
+    ``jax.devices()`` — probes size their meshes to what is available, so
+    the sweep runs (degenerately) even on one device.
+    """
+    from .. import parallel
+
+    names = tuple(entries) if entries is not None else (
+        parallel.COLLECTIVE_ENTRY_POINTS
+    )
+    rep = AnalysisReport()
+    for name in names:
+        try:
+            mod = importlib.import_module(f".{name}", parallel.__name__)
+            fn, args = mod.collective_probe(devices=devices)
+            rep.extend(analyze_collectives_jaxpr(fn, *args, where=name))
+        except Exception as e:  # noqa: BLE001 — any probe failure is a finding
+            rep.add(
+                "COL008",
+                Severity.ERROR,
+                f"parallel entry point {name!r} failed to trace: "
+                f"{type(e).__name__}: {e}",
+                task=name,
+            )
+    return rep.dedupe()

@@ -192,7 +192,37 @@ class CompiledSchedule:
             self._build_single(params, graph_input, avals)
         else:
             self._build_mesh(params, graph_input, avals)
+        if pre_analysis and gate_enabled():
+            # donation invariant (analysis/donation_pass): the donation
+            # vector must cover only per-run transient inputs — donating
+            # the aliased param slab would corrupt every later rep
+            from ..analysis.donation_pass import analyze_donation
+
+            analyze_donation(self).raise_if_errors()
         return self
+
+    def donation_summary(self) -> Dict[str, Any]:
+        """Static donation metadata for ``analysis/donation_pass``: which
+        jit argument positions hold the (aliased, rep-crossing) param
+        slabs, which hold the per-run transient input leaves, and which
+        the program donates."""
+        if self._single_device is not None:
+            # program(placed_params, x): donation covers the graph input
+            return {
+                "path": "single",
+                "param_argnums": (0,),
+                "input_argnums": (1,),
+                "donated_argnums": (1,) if self.donate else (),
+            }
+        n_in = len(self._in_shardings)
+        return {
+            "path": "mesh",
+            "param_argnums": (0,),  # the dtype-keyed slab tuple
+            "input_argnums": tuple(range(1, 1 + n_in)),
+            "donated_argnums": (
+                tuple(range(1, 1 + n_in)) if self.donate else ()
+            ),
+        }
 
     def _needed_globals(self, node: str) -> List[str]:
         """Ordered dedupe of the param globals ``node``'s tasks read."""

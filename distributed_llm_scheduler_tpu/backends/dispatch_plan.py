@@ -568,7 +568,7 @@ class DispatchPlan:
         keep_list = tuple(
             (t, slot_of[t]) for exports in exports_of for t in exports
         ) if keep_outputs else ()
-        return cls(
+        plan = cls(
             backend, steps, n_slots, ext_slots,
             tuple(
                 (n, backend.cluster[n].jax_device, s)
@@ -577,6 +577,44 @@ class DispatchPlan:
             fence_slots, final_slot, keep_list, transfer_edges,
             donate, coalesce,
         )
+        # donation self-check (analysis/donation_pass): re-derives the
+        # lifetime safety the builder just computed, from the exported
+        # metadata alone — a donation bug here frees a live buffer, so
+        # it joins the pre-execution gate rather than trusting the
+        # builder that produced it
+        if donate and getattr(backend, "pre_analysis", True):
+            from ..analysis import gate_enabled
+            from ..analysis.donation_pass import analyze_donation
+
+            if gate_enabled():
+                analyze_donation(plan).raise_if_errors()
+        return plan
+
+    # -- analysis metadata -------------------------------------------------
+    def donation_table(self) -> Dict[str, Any]:
+        """Static donation metadata for ``analysis/donation_pass``:
+        per-step slot reads/transfers/donations plus the post-run readers
+        (fence, final output, keep list, ext values).  Pure data — the
+        pass never touches live buffers or jitted callables, so external
+        tooling can verify a plan without being able to run it."""
+        return {
+            "steps": tuple(
+                {
+                    "tids": st.tids,
+                    "node_id": st.node_id,
+                    "arg_slots": st.arg_slots,
+                    "xfer_slots": st.xfer_slots,
+                    "donate_slots": st.donate_slots,
+                    "out_slots": st.out_slots,
+                }
+                for st in self.steps
+            ),
+            "fence_slots": self.fence_slots,
+            "final_slot": self.final_slot,
+            "keep_list": self.keep_list,
+            "ext_slots": self.ext_slots,
+            "n_slots": self.n_slots,
+        }
 
     # -- identity ----------------------------------------------------------
     def signature(self) -> Tuple:

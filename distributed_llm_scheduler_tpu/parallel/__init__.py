@@ -1,0 +1,24 @@
+"""Hand-written parallel strategies (ring/Ulysses attention, expert,
+pipeline, train, decode) over jax.sharding meshes.
+
+Every module listed in :data:`COLLECTIVE_ENTRY_POINTS` exports a
+``collective_probe(devices=None) -> (fn, example_avals)`` hook: a
+traceable entry point plus canned abstract inputs sized for a small CPU
+mesh.  ``analysis.parallel_sweep`` traces each probe with
+``jax.make_jaxpr`` (zero FLOPs) and runs the COL003/COL004 collective
+checks over the jaxpr, so `lint --parallel` covers the whole hand-written
+parallel layer on every run.  Adding a strategy module means adding its
+probe here — a missing or broken probe fails the sweep with COL008
+rather than silently shrinking coverage.
+"""
+
+#: modules under this package carrying a ``collective_probe`` hook,
+#: swept by ``analysis.parallel_sweep.sweep_parallel_collectives``
+COLLECTIVE_ENTRY_POINTS = (
+    "ring_attention",
+    "ulysses",
+    "expert",
+    "pipeline_pp",
+    "train",
+    "decode",
+)

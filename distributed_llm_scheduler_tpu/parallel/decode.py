@@ -109,3 +109,28 @@ def generate_sharded(
     return mod.generate(
         params, prompt_ids, config, max_new_tokens, key=key, **kw
     )
+
+
+def collective_probe(devices=None):
+    """``(fn, example_avals)`` for the analysis sweep (lint --parallel):
+    tensor-parallel greedy decode of 2 tokens on tiny GPT-2, abstract
+    params via ``eval_shape``.  Megatron collectives are GSPMD-derived,
+    so the sweep mostly proves the sharded decode still traces."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import gpt2
+
+    devs = list(devices if devices is not None else jax.devices())
+    tp = 2 if len(devs) >= 2 else 1  # tiny() has n_head=4: tp=2 divides
+    mesh = Mesh(np.array(devs[:tp]).reshape(1, 1, tp), ("dp", "sp", "tp"))
+    config = gpt2.GPT2Config.tiny()
+    params = jax.eval_shape(
+        lambda key: gpt2.init_params(config, key), jax.random.PRNGKey(0)
+    )
+    ids = jax.ShapeDtypeStruct((1, 4), jnp.int32)
+
+    def fn(params, ids):
+        return generate_sharded(params, ids, config, mesh, 2)
+
+    return fn, (params, ids)

@@ -383,3 +383,32 @@ def make_moe_train_step(
         return jitted(state, input_ids, targets)
 
     return train_step, init_state
+
+
+def collective_probe(devices=None):
+    """``(fn, example_avals)`` for the analysis sweep (lint --parallel):
+    the routed capacity-buffer MoE on a dp x ep mesh.  The all-to-all
+    here is GSPMD-derived from the sharding-constraint pair, so the
+    traced jaxpr mostly validates that the strategy still traces; any
+    hand-written collective that creeps in gets the COL003/COL004
+    checks."""
+    import numpy as np
+
+    devs = list(devices if devices is not None else jax.devices())
+    ep = 2 if len(devs) >= 2 else 1
+    dp = 2 if len(devs) >= 4 else 1
+    mesh = Mesh(np.array(devs[: dp * ep]).reshape(dp, ep), ("dp", "ep"))
+    config = MixtralConfig.tiny()
+    D, E, F = config.d_model, config.n_experts, config.ffn_hidden
+    bp = {
+        "router": jax.ShapeDtypeStruct((D, E), config.dtype),
+        "moe_gate": jax.ShapeDtypeStruct((E, D, F), config.dtype),
+        "moe_up": jax.ShapeDtypeStruct((E, D, F), config.dtype),
+        "moe_down": jax.ShapeDtypeStruct((E, F, D), config.dtype),
+    }
+    x = jax.ShapeDtypeStruct((2, 8, D), config.dtype)
+
+    def fn(bp, x):
+        return moe_routed_stacked(bp, x, config, mesh=mesh)
+
+    return fn, (bp, x)

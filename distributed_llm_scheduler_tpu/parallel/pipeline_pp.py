@@ -236,3 +236,28 @@ def make_pp_train_step(
     return make_step_from_loss(
         loss, lambda key: mod.init_params(config, key), optimizer
     )
+
+
+def collective_probe(devices=None):
+    """``(fn, example_avals)`` for the analysis sweep (lint --parallel):
+    the whole-program GPipe scan on a 2-stage pp mesh (1 stage on a
+    single device), tiny GPT-2, abstract params via ``eval_shape`` — the
+    successor-hop ppermute and the final psum land in the traced jaxpr
+    for the COL003/COL004 checks."""
+    import numpy as np
+
+    from ..models import gpt2
+
+    devs = list(devices if devices is not None else jax.devices())
+    S = 2 if len(devs) >= 2 else 1
+    mesh = Mesh(np.array(devs[:S]), ("pp",))
+    config = gpt2.GPT2Config.tiny()
+    params = jax.eval_shape(
+        lambda key: gpt2.init_params(config, key), jax.random.PRNGKey(0)
+    )
+    ids = jax.ShapeDtypeStruct((4, 8), jnp.int32)
+
+    def fn(params, ids):
+        return pipeline_forward(params, ids, config, mesh, microbatches=2)
+
+    return fn, (params, ids)

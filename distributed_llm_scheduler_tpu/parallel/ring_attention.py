@@ -129,3 +129,26 @@ def reference_causal_attention(q, k, v):
     scores = jnp.where(j <= i, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def collective_probe(devices=None):
+    """``(fn, example_avals)`` for the analysis sweep (lint --parallel).
+
+    Traces the shard_map'd ring body abstractly — zero FLOPs — so
+    ``analysis.parallel_sweep`` can check the ppermute ring schedule
+    (COL003/COL004) on every lint run.
+    """
+    devs = list(devices if devices is not None else jax.devices())[:4]
+    import numpy as np
+
+    mesh = Mesh(np.array(devs), ("sp",))
+    spec = P(None, None, "sp", None)
+    fn = shard_map(
+        partial(ring_attention, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    x = jax.ShapeDtypeStruct((1, 2, 4 * len(devs), 8), jnp.float32)
+    return fn, (x, x, x)

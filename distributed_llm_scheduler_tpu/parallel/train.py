@@ -132,3 +132,22 @@ def make_eval_step(config: GPT2Config, mesh: Mesh, seq_parallel: bool = False):
         return fwd(params, jax.device_put(input_ids, data_sh))
 
     return eval_step
+
+
+def collective_probe(devices=None):
+    """``(fn, example_avals)`` for the analysis sweep (lint --parallel):
+    one dp x tp train step on tiny GPT-2 with the TrainState built
+    abstractly (``eval_shape``) — the collectives are GSPMD-derived from
+    the shardings, so the sweep mostly proves the strategy still traces
+    end to end."""
+    from .mesh import make_mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    tp = 2 if len(devs) >= 2 else 1  # tiny() has n_head=4: tp=2 divides
+    dp = 2 if len(devs) >= 4 else 1
+    mesh = make_mesh(dp=dp, tp=tp, devices=devs)
+    config = GPT2Config.tiny()
+    train_step, init_state = make_train_step(config, mesh)
+    state = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    ids = jax.ShapeDtypeStruct((4, 8), jnp.int32)
+    return train_step, (state, ids, ids)

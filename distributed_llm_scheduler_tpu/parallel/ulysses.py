@@ -98,3 +98,25 @@ def ulysses_attention_sharded(
     return fn(
         jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
     )
+
+
+def collective_probe(devices=None):
+    """``(fn, example_avals)`` for the analysis sweep (lint --parallel):
+    the shard_map'd Ulysses body with heads divisible by the sp axis, so
+    both all_to_all redistributions land in the traced jaxpr."""
+    devs = list(devices if devices is not None else jax.devices())[:4]
+    import jax.numpy as jnp
+    import numpy as np
+
+    mesh = Mesh(np.array(devs), ("sp",))
+    sp = len(devs)
+    spec = P(None, None, "sp", None)
+    fn = shard_map(
+        partial(ulysses_attention, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    x = jax.ShapeDtypeStruct((1, 2 * sp, 4 * sp, 8), jnp.float32)
+    return fn, (x, x, x)

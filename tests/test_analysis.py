@@ -605,3 +605,331 @@ def test_lint_cli_flags_failed_fit(capsys):
     out = capsys.readouterr()
     assert rc == 0
     assert "failed" in out.err
+
+
+# -- pass: MPMD happens-before (hb_pass) -------------------------------------
+
+from distributed_llm_scheduler_tpu.analysis import (  # noqa: E402
+    StageOp,
+    analyze_happens_before,
+    stage_programs_1f1b,
+)
+
+
+@pytest.mark.parametrize("S,M", [(1, 2), (2, 4), (3, 6), (4, 8)])
+def test_hb_1f1b_is_clean(S, M):
+    # the golden deadlock-free reference: no errors, and the steady
+    # state overlaps (no COL007 serialization warning) whenever there
+    # is more than one stage
+    rep = analyze_happens_before(stage_programs_1f1b(S, M))
+    assert rep.ok, [d.render() for d in rep.diagnostics]
+    assert not rep.has("COL007")
+
+
+def test_hb_bidirectional_exchange_deadlocks():
+    # both stages post their recv before their send: the canonical
+    # MPMD deadlock — each wait's matching send sits behind the wait
+    stages = {
+        "stage0": [
+            StageOp("recv", "stage1", "b"),
+            StageOp("compute", None, "x"),
+            StageOp("send", "stage1", "a"),
+        ],
+        "stage1": [
+            StageOp("recv", "stage0", "a"),
+            StageOp("compute", None, "y"),
+            StageOp("send", "stage0", "b"),
+        ],
+    }
+    rep = analyze_happens_before(stages)
+    assert rep.exit_code == 1
+    (d,) = rep.by_code("COL005")
+    assert d.severity == Severity.ERROR
+    assert "deadlock" in d.message
+    # the rendered cycle names both stages' ops
+    assert "stage0:" in d.message and "stage1:" in d.message
+
+
+def test_hb_send_first_exchange_is_clean():
+    # same channel pattern, send posted first: buffered sends make this
+    # legal — the model must NOT treat sends as rendezvous
+    stages = {
+        "stage0": [("send", "stage1", "a"), ("recv", "stage1", "b")],
+        "stage1": [("send", "stage0", "b"), ("recv", "stage0", "a")],
+    }
+    assert analyze_happens_before(stages).ok
+
+
+def test_hb_cardinality_and_tag_mismatch():
+    rep = analyze_happens_before({
+        "stage0": [("send", "stage1", "f0"), ("send", "stage1", "f1")],
+        "stage1": [("recv", "stage0", "f0")],
+    })
+    (d,) = rep.by_code("COL006")
+    assert d.data == {"sends": 2, "recvs": 1}
+    rep = analyze_happens_before({
+        "stage0": [("send", "stage1", "f0")],
+        "stage1": [("recv", "stage0", "g0")],
+    })
+    assert rep.has("COL006")  # matched position, different value tag
+
+
+def test_hb_collective_order_divergence_cycles():
+    # two stages disagreeing on the relative order of two rendezvous
+    # collectives: a cycle through the merged nodes
+    rep = analyze_happens_before({
+        "stage0": [("collective", None, "ar1"), ("collective", None, "ar2")],
+        "stage1": [("collective", None, "ar2"), ("collective", None, "ar1")],
+    })
+    assert rep.has("COL005")
+
+
+def test_hb_serialized_ping_pong_warns_col007():
+    # stage1 cannot start microbatch m before stage0 finishes BOTH of
+    # its computes for m, and stage0 waits for the gradient before the
+    # next microbatch: zero overlap, one active stage at a time
+    s0, s1 = [], []
+    for m in range(4):
+        s0 += [
+            ("compute", None, f"f{m}"), ("send", "stage1", f"f{m}"),
+            ("recv", "stage1", f"g{m}"), ("compute", None, f"g{m}"),
+        ]
+        s1 += [
+            ("recv", "stage0", f"f{m}"), ("compute", None, f"f{m}"),
+            ("compute", None, f"g{m}"), ("send", "stage0", f"g{m}"),
+        ]
+    rep = analyze_happens_before({"stage0": s0, "stage1": s1})
+    (d,) = rep.by_code("COL007")
+    assert d.severity == Severity.WARNING
+    assert rep.exit_code == 0  # warning, not an error
+    assert "bubbles" in d.message  # cross-reference to obs attribution
+
+
+def test_hb_gate_wiring():
+    g = TaskGraph([Task("a", 0.1, 1.0, [], set())]).freeze()
+    dead = {
+        "stage0": [("recv", "stage1", "b"), ("send", "stage1", "a")],
+        "stage1": [("recv", "stage0", "a"), ("send", "stage0", "b")],
+    }
+    with pytest.raises(AnalysisError) as ei:
+        pre_execution_gate(
+            g, two_caps(), sched({"n0": ["a"]}), backend="device",
+            stage_programs=dead,
+        )
+    assert ei.value.report.has("COL005")
+    # COL007 is a warning: a serialized-but-acyclic program passes
+    ok = pre_execution_gate(
+        g, two_caps(), sched({"n0": ["a"]}), backend="device",
+        stage_programs=stage_programs_1f1b(2, 4),
+    )
+    assert ok is not None and ok.ok
+
+
+# -- pass: donation-alias races (donation_pass) ------------------------------
+
+from distributed_llm_scheduler_tpu.analysis import analyze_donation  # noqa: E402
+
+
+def _table(steps, **kw):
+    base = {
+        "steps": tuple(steps), "fence_slots": (), "final_slot": None,
+        "keep_list": (), "ext_slots": (), "n_slots": 8,
+    }
+    base.update(kw)
+    return base
+
+
+def _step(tid, node="d0", arg_slots=(), xfer_slots=(), donate_slots=(),
+          out_slots=()):
+    return {
+        "tids": (tid,), "node_id": node, "arg_slots": tuple(arg_slots),
+        "xfer_slots": tuple(xfer_slots), "donate_slots": tuple(donate_slots),
+        "out_slots": tuple(out_slots),
+    }
+
+
+def test_donation_read_after_donation():
+    rep = analyze_donation(_table([
+        _step("a", arg_slots=(0,), donate_slots=(0,), out_slots=(1,)),
+        _step("b", arg_slots=(0, 1), out_slots=(2,)),
+    ], final_slot=2))
+    (d,) = rep.by_code("DON001")
+    assert d.severity == Severity.ERROR
+    assert d.data["slot"] == 0 and "freed" in d.message
+
+
+def test_donation_double_donation():
+    rep = analyze_donation(_table([
+        _step("a", arg_slots=(0,), donate_slots=(0,), out_slots=(1,)),
+        _step("b", arg_slots=(2,), donate_slots=(0,), out_slots=(3,)),
+    ]))
+    assert rep.has("DON002")
+    rep = analyze_donation(_table([
+        _step("a", arg_slots=(0,), donate_slots=(0, 0), out_slots=(1,)),
+    ]))
+    (d,) = rep.by_code("DON002")
+    assert "twice" in d.message
+
+
+def test_donation_cross_device_transfer_race():
+    rep = analyze_donation(_table([
+        _step("a", node="d0", arg_slots=(0,), donate_slots=(0,),
+              out_slots=(1,)),
+        _step("b", node="d1", arg_slots=(0,), xfer_slots=(0,),
+              out_slots=(2,)),
+    ]))
+    (d,) = rep.by_code("DON003")
+    assert "across the device boundary" in d.message
+    assert not rep.has("DON001")  # classified as the race, not the read
+
+
+def test_donation_post_run_readers():
+    rep = analyze_donation(_table(
+        [_step("a", arg_slots=(0,), donate_slots=(0,), out_slots=(1,))],
+        final_slot=0,
+    ))
+    assert rep.has("DON001")
+    rep = analyze_donation(_table(
+        [_step("a", arg_slots=(0,), donate_slots=(0,), out_slots=(1,))],
+        fence_slots=(("d1", 0),),
+    ))
+    assert rep.has("DON001")
+    rep = analyze_donation(_table(
+        [_step("a", arg_slots=(0,), donate_slots=(0,), out_slots=(1,))],
+        keep_list=(("t0", 0),),
+    ))
+    assert rep.has("DON001")
+
+
+def test_donation_last_consumer_is_clean():
+    # reading AND donating a slot in the same launch is the normal
+    # pattern — no diagnostic
+    rep = analyze_donation(_table([
+        _step("a", arg_slots=(0,), donate_slots=(0,), out_slots=(1,)),
+        _step("b", arg_slots=(1,), donate_slots=(1,), out_slots=(2,)),
+    ], final_slot=2))
+    assert rep.ok, [d.render() for d in rep.diagnostics]
+
+
+def test_donation_compiled_summary():
+    clean = {
+        "path": "mesh", "param_argnums": (0,),
+        "input_argnums": (1, 2), "donated_argnums": (1, 2),
+    }
+    assert analyze_donation(clean).ok
+    rep = analyze_donation({**clean, "donated_argnums": (0, 1)})
+    assert rep.has("DON002")  # donating the aliased param slab
+    rep = analyze_donation({**clean, "donated_argnums": (1, 5)})
+    assert rep.has("DON003")  # argnum 5 is not a per-run input
+
+
+def test_donation_gate_wiring():
+    g = TaskGraph([Task("a", 0.1, 1.0, [], set())]).freeze()
+    bad = _table([
+        _step("a", arg_slots=(0,), donate_slots=(0,), out_slots=(1,)),
+        _step("b", arg_slots=(0,), out_slots=(2,)),
+    ])
+    with pytest.raises(AnalysisError) as ei:
+        pre_execution_gate(
+            g, two_caps(), sched({"n0": ["a"]}), backend="device", plan=bad,
+        )
+    assert ei.value.report.has("DON001")
+    rep = analyze(g, stage_programs=stage_programs_1f1b(2, 2), plan=bad)
+    assert rep.has("DON001")  # analyze() wires both new passes through
+
+
+# -- collective walk: custom-derivative calls + dedupe -----------------------
+
+def test_collective_walk_sees_through_custom_derivatives():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llm_scheduler_tpu.analysis import (
+        analyze_collectives_jaxpr,
+    )
+    from distributed_llm_scheduler_tpu.parallel.compat import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    perm = [(0, 1), (1, 0)]
+
+    @jax.custom_jvp
+    def rotate(v):
+        return jax.lax.ppermute(v, "x", perm)
+
+    @rotate.defjvp
+    def _rotate_jvp(primals, tangents):
+        return rotate(primals[0]), jax.lax.ppermute(tangents[0], "x", perm)
+
+    @jax.custom_vjp
+    def rotate2(v):
+        return jax.lax.ppermute(v, "x", [(0, 0), (1, 0)])  # repeated dst
+
+    rotate2.defvjp(
+        lambda v: (rotate2(v), None),
+        lambda _res, g: (g,),
+    )
+
+    def check(body):
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"),
+            check_vma=False,
+        )
+        return analyze_collectives_jaxpr(
+            fn, jax.ShapeDtypeStruct((2,), jnp.float32), where="t"
+        )
+
+    # the jvp-wrapped ppermute has a valid perm: walk reaches it, clean
+    assert check(rotate).ok
+    # the vjp-wrapped ppermute repeats a destination: COL004 — a
+    # malformed perm must not hide behind the custom-derivative call
+    rep = check(rotate2)
+    assert rep.has("COL004")
+
+
+def test_report_dedupe_counts_occurrences():
+    from distributed_llm_scheduler_tpu.analysis import AnalysisReport
+
+    rep = AnalysisReport()
+    for _ in range(3):
+        rep.add("COL004", Severity.ERROR, "perm is bad", task="t")
+    rep.add("COL004", Severity.ERROR, "perm is bad", task="other")
+    rep = rep.dedupe()
+    assert len(rep.diagnostics) == 2  # distinct provenance survives
+    d = rep.diagnostics[0]
+    assert d.data["occurrences"] == 3
+    assert "(x3)" in d.render()
+    assert "(x" not in rep.diagnostics[1].render()
+
+
+# -- parallel-strategy sweep + CLI -------------------------------------------
+
+def test_parallel_sweep_covers_registry_and_is_clean():
+    from distributed_llm_scheduler_tpu import parallel
+    from distributed_llm_scheduler_tpu.analysis import (
+        sweep_parallel_collectives,
+    )
+
+    assert set(parallel.COLLECTIVE_ENTRY_POINTS) == {
+        "ring_attention", "ulysses", "expert", "pipeline_pp", "train",
+        "decode",
+    }
+    rep = sweep_parallel_collectives()
+    assert rep.ok, [d.render() for d in rep.diagnostics]
+
+
+def test_parallel_sweep_flags_broken_probe_col008():
+    from distributed_llm_scheduler_tpu.analysis import (
+        sweep_parallel_collectives,
+    )
+
+    rep = sweep_parallel_collectives(entries=("no_such_module",))
+    (d,) = rep.by_code("COL008")
+    assert d.severity == Severity.ERROR and d.task == "no_such_module"
+
+
+def test_lint_cli_parallel():
+    from distributed_llm_scheduler_tpu.__main__ import main
+
+    assert main(["lint", "--parallel"]) == 0
+    assert main(["lint", "--parallel", "--decode"]) == 2

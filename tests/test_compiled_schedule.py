@@ -295,3 +295,29 @@ def test_compiled_incompatible_flags(dag_setup):
             backend.execute(
                 dag.graph, schedule, params, ids, compiled=True, **bad
             )
+
+
+def test_donation_summary_passes_analysis(dag_setup):
+    """The compiled donation vector covers exactly the per-run transient
+    inputs — the DON00x pass verifies it on both the mesh and the
+    single-device paths, and rejects a slab-donating summary."""
+    from distributed_llm_scheduler_tpu.analysis import analyze_donation
+
+    dag, params, ids = dag_setup
+    for n in (1, 4):
+        cluster = Cluster.from_jax_devices(
+            jax.devices()[:n], hbm_cap_gb=8.0
+        )
+        backend = DeviceBackend(cluster)
+        schedule = get_scheduler("roundrobin").schedule(dag.graph, cluster)
+        cs = CompiledSchedule.build(
+            backend, dag.graph, schedule, params, ids, donate=True
+        )
+        summary = cs.donation_summary()
+        assert summary["path"] == ("single" if n == 1 else "mesh")
+        assert summary["donated_argnums"]  # donate=True actually donates
+        assert 0 not in summary["donated_argnums"]  # never the slabs
+        assert analyze_donation(cs).ok
+    assert analyze_donation(
+        {**summary, "donated_argnums": (0,)}
+    ).has("DON002")

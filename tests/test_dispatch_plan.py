@@ -232,3 +232,36 @@ def test_donation_frees_dying_intermediates(setup):
     np.testing.assert_allclose(
         np.asarray(rd.output), np.asarray(rn.output), rtol=0, atol=0
     )
+
+
+# -- donation-alias analysis (analysis/donation_pass) -------------------
+
+
+def test_donation_table_passes_analysis(setup):
+    """A builder-produced plan is donation-safe by construction; the
+    independent DON00x pass must agree, and must catch a hand-mutated
+    table that re-reads a donated slot."""
+    from distributed_llm_scheduler_tpu.analysis import analyze_donation
+
+    plan = _build(setup, donate=donation_supported())
+    table = plan.donation_table()
+    assert table["steps"] and table["final_slot"] is not None
+    assert analyze_donation(plan).ok
+
+    donated = [
+        (gi, s)
+        for gi, st in enumerate(table["steps"])
+        for s in st["donate_slots"]
+    ]
+    if donated:  # mutate: a later launch re-reads a donated slot
+        _gi, slot = donated[0]
+        bad = dict(table)
+        bad["steps"] = table["steps"] + (
+            {
+                "tids": ("late_reader",),
+                "node_id": table["steps"][0]["node_id"],
+                "arg_slots": (slot,), "xfer_slots": (),
+                "donate_slots": (), "out_slots": (),
+            },
+        )
+        assert analyze_donation(bad).has("DON001")
