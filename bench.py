@@ -591,6 +591,23 @@ def measure(
     if peak_modeled is not None:
         log(f"bench: modeled per-core peak (no-evict) {peak_modeled:.2f} GB "
             f"on {hbm_gb:.0f} GB budget; validator ok={vrep.ok}")
+    # memory doctor regression surface: the same replay, kept per device
+    # (the flattened peak_hbm_bytes.<node> metrics — a placement change
+    # that moves one device's peak is invisible to the max alone), plus
+    # the modeled KV page-pool peak of the canonical decode-leg geometry
+    # (slots=2, prompt 8 + 6 new, 8-token pages — the observed-CLI leg)
+    from distributed_llm_scheduler_tpu.core.graph import GB as _GB
+    from distributed_llm_scheduler_tpu.eval.benchlib import (
+        modeled_kv_pages_peak,
+    )
+
+    peak_bytes_per_node = {
+        node: int(round(gb * _GB))
+        for node, gb in sorted(vrep.peak_no_evict_gb.items())
+    } or None
+    kv_pages_peak = modeled_kv_pages_peak(
+        slots=2, prompt_len=8, max_new=6, page_size=8
+    )
 
     result = BenchResult(
         n_policies=len(makespans),
@@ -602,6 +619,8 @@ def measure(
         fallback=bool(cost_suffix) or f32_fallback,
         peak_hbm_gb_measured=peak_measured,
         peak_hbm_gb_modeled=peak_modeled,
+        peak_hbm_bytes=peak_bytes_per_node,
+        kv_pages_peak=kv_pages_peak,
         mfu_single_chip=mfu,
         dispatch_overhead=overhead,
         link_provenance=link_prov,

@@ -1212,9 +1212,19 @@ def cmd_doctor(args) -> int:
     trace JSON offline; without it, one profiled ``DeviceBackend``
     execute of the model DAG is attributed directly.  Exit 2 when
     nothing is attributable, 1 when drift exceeds ``--drift-threshold``,
-    0 otherwise."""
+    0 otherwise.
+
+    ``--memory`` switches to the MEMORY doctor: one memprof-instrumented
+    execute (the default planned path — no per-task profile fences
+    needed), printing the per-device HBM timelines/watermarks
+    (``memory``) and the measured-vs-predicted peak comparison
+    (``mem_drift``).  Exit 2 when nothing was recorded or the timeline
+    invariant fails, 1 when any device's two-sided drift ratio exceeds
+    ``--mem-drift-threshold``, 0 otherwise."""
     from .obs.attribution import attribute_run, attribute_trace
 
+    if getattr(args, "memory", False):
+        return _cmd_doctor_memory(args)
     if args.trace:
         try:
             att = attribute_trace(args.trace)
@@ -1275,6 +1285,75 @@ def cmd_doctor(args) -> int:
               f"--drift-threshold {args.drift_threshold:g}x gate",
               file=sys.stderr)
         return 1
+    return 0
+
+
+def _cmd_doctor_memory(args) -> int:
+    """The memory half of the doctor (``doctor --memory``)."""
+    from .backends.device import DeviceBackend
+    from .obs import MemoryProfiler, compute_mem_drift
+    from .obs.trace import Tracer
+
+    cfg = _config_from(args)
+    dag = cfg.build_graph()
+    if not hasattr(dag, "graph"):
+        print("doctor --memory needs a model DAG (gpt2* / llama* / "
+              "mixtral*); synthetic graphs have no fns", file=sys.stderr)
+        return 2
+    cluster = cfg.build_cluster_with_devices()
+    schedule = cfg.build_scheduler().schedule(dag.graph, cluster)
+    tracer = Tracer()
+    mem = MemoryProfiler(tracer=tracer)
+    DeviceBackend(cluster).execute(
+        dag.graph, schedule, dag.init_params(), dag.make_inputs(),
+        trace=tracer, memprof=mem,
+    )
+    if not len(mem):
+        print("doctor: run recorded no memory events", file=sys.stderr)
+        return 2
+    errs = mem.verify()
+    if errs:
+        for e in errs[:10]:
+            print(f"doctor: memory timeline invariant: {e}",
+                  file=sys.stderr)
+        return 2
+    drift = compute_mem_drift(dag.graph, cluster, schedule, mem)
+    print(json.dumps(
+        {"memory": mem.summary(), "mem_drift": drift.summary()},
+        indent=1,
+    ))
+    for w in drift.warnings:
+        print(f"doctor: {w}", file=sys.stderr)
+    if drift.exceeds(args.mem_drift_threshold):
+        print(f"doctor: worst per-device memory drift ratio "
+              f"{drift.worst_ratio():.2f}x exceeds the "
+              f"--mem-drift-threshold {args.mem_drift_threshold:g}x gate",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_metrics_diff(args) -> int:
+    """``metrics diff A B``: counter/gauge deltas and histogram quantile
+    shifts between two ``dls.metrics/1`` snapshots.  Exit 2 on an
+    unreadable file or schema mismatch."""
+    from .obs.metrics import diff_snapshots
+
+    snaps = []
+    for path in (args.snapshot_a, args.snapshot_b):
+        try:
+            with open(path) as f:
+                snaps.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"metrics diff: unreadable snapshot {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    try:
+        diff = diff_snapshots(*snaps)
+    except ValueError as e:
+        print(f"metrics diff: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(diff, indent=1))
     return 0
 
 
@@ -1534,6 +1613,16 @@ def main(argv=None) -> int:
     p.add_argument("--skip-decode", action="store_true", dest="skip_decode",
                    help="skip the paged decode leg")
     p.set_defaults(fn=cmd_metrics)
+    msub = p.add_subparsers(dest="metrics_cmd")
+    pd = msub.add_parser(
+        "diff",
+        help="diff two dls.metrics/1 snapshot files: counter/gauge "
+             "deltas + histogram p50/p95 shifts (exit 2 on schema "
+             "mismatch)",
+    )
+    pd.add_argument("snapshot_a", help="before snapshot JSON")
+    pd.add_argument("snapshot_b", help="after snapshot JSON")
+    pd.set_defaults(fn=cmd_metrics_diff)
 
     p = sub.add_parser(
         "doctor",
@@ -1553,6 +1642,17 @@ def main(argv=None) -> int:
                    help="exit 1 when any task's two-sided predicted-vs-"
                         "measured ratio max(r, 1/r) exceeds RATIO "
                         "(default: report only, never gate)")
+    p.add_argument("--memory", action="store_true",
+                   help="memory doctor: measured per-device HBM "
+                        "timelines, watermark attribution, and "
+                        "measured-vs-predicted peak drift instead of the "
+                        "time doctor")
+    p.add_argument("--mem-drift-threshold", type=float, default=None,
+                   dest="mem_drift_threshold", metavar="RATIO",
+                   help="with --memory: exit 1 when any device's "
+                        "two-sided measured-vs-predicted peak ratio "
+                        "max(r, 1/r) exceeds RATIO (default: report "
+                        "only, never gate)")
     p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(

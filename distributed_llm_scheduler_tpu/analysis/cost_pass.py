@@ -19,9 +19,9 @@ against the already-raised values and can only catch over-prediction.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
-from ..core.graph import TaskGraph
+from ..core.graph import GB, TaskGraph
 from .diagnostics import AnalysisReport, Severity
 
 #: divergence threshold: flag when one estimate exceeds ``factor`` times
@@ -33,18 +33,43 @@ DEFAULT_FACTOR = 2.0
 _FLOOR_GB = 1e-3
 
 
+def _measured_task_gb(memory_report: Any) -> Dict[str, float]:
+    """Per-task measured output footprints (GB) from a memprof source:
+    either a live ``obs.memprof.MemoryProfiler`` (``task_output_bytes``)
+    or a plain ``{tid: bytes}`` dict loaded from a report artifact."""
+    if memory_report is None:
+        return {}
+    if hasattr(memory_report, "task_output_bytes"):
+        memory_report = memory_report.task_output_bytes()
+    try:
+        return {
+            str(t): int(b) / GB for t, b in dict(memory_report).items()
+        }
+    except (TypeError, ValueError, AttributeError):
+        return {}
+
+
 def analyze_cost(
     graph: TaskGraph,
     compiled_gb: Dict[str, float],
     analytic_gb: Optional[Dict[str, float]] = None,
     factor: float = DEFAULT_FACTOR,
+    memory_report: Any = None,
 ) -> AnalysisReport:
     """Compare analytic vs compiled per-task memory, flag >factor gaps.
 
     ``compiled_gb`` is ``utils.hbm.preflight_task_memory``'s result;
     ``analytic_gb`` the pre-preflight ``memory_required`` snapshot
     (falls back to the graph's current values).
+
+    ``memory_report`` (optional): a measured memory source — an
+    ``obs.memprof.MemoryProfiler`` or a ``{tid: bytes}`` mapping of
+    measured task-output births.  When a flagged task has a measurement,
+    the diagnostic's ``data`` gains ``measured_gb``, so the CST00x
+    payloads carry all three numbers (analytic / compiled / measured)
+    and downstream tooling can tell which estimate reality sides with.
     """
+    measured_gb = _measured_task_gb(memory_report)
     rep = AnalysisReport()
     for task in graph.tasks():
         tid = task.task_id
@@ -55,13 +80,16 @@ def analyze_cost(
         )
         if tid not in compiled_gb:
             if analytic > _FLOOR_GB:
+                data3 = {"analytic_gb": analytic}
+                if tid in measured_gb:
+                    data3["measured_gb"] = measured_gb[tid]
                 rep.add(
                     "CST003",
                     Severity.INFO,
                     f"no XLA preflight measurement for {tid!r} "
                     f"(analytic {analytic:.3f} GB unchecked)",
                     task=tid,
-                    data={"analytic_gb": analytic},
+                    data=data3,
                 )
             continue
         compiled = compiled_gb[tid]
@@ -72,6 +100,8 @@ def analyze_cost(
             "compiled_gb": compiled,
             "factor": factor,
         }
+        if tid in measured_gb:
+            data["measured_gb"] = measured_gb[tid]
         if compiled > factor * max(analytic, _FLOOR_GB):
             rep.add(
                 "CST001",

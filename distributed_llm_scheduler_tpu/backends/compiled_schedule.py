@@ -128,6 +128,11 @@ class CompiledSchedule:
     _mesh: Any = field(repr=False, default=None)
     _signature: Tuple = ()
     _single_device: Any = field(repr=False, default=None)
+    # static memory-profiler tables: (dst_node, tid, bytes) per exchange,
+    # and the final output's (node, bytes) — avals are not retained, so
+    # the sizes are frozen at build time
+    _exchange_table: Tuple = ()
+    _final_out: Tuple = ()
 
     # -- construction ------------------------------------------------------
 
@@ -188,10 +193,20 @@ class CompiledSchedule:
             transfer_edges=ir.n_exchanges,
             transfer_bytes=tbytes,
         )
+        self._exchange_table = tuple(
+            (ex.dst, ex.tid, _leaf_bytes(avals[ex.tid]))
+            for ph in ir.phases
+            for ex in ph.exchanges
+        )
         if len(ir.devices) == 1:
             self._build_single(params, graph_input, avals)
         else:
             self._build_mesh(params, graph_input, avals)
+        if self._final_tid is not None:
+            owner = ir.devices[self._owner_index]
+            self._final_out = (
+                self._final_tid, owner, _leaf_bytes(avals[self._final_tid])
+            )
         if pre_analysis and gate_enabled():
             # donation invariant (analysis/donation_pass): the donation
             # vector must cover only per-run transient inputs — donating
@@ -527,11 +542,18 @@ class CompiledSchedule:
         fence: bool = True,
         tracer: Any = None,
         metrics: Any = None,
+        mem: Any = None,
     ) -> Tuple[
         Any, Dict, int, int, int, int, Dict[str, Any], Dict[str, float]
     ]:
         """Stage, launch, (optionally) fence.  Same 8-tuple contract as
-        ``DispatchPlan.run`` / ``_run_segmented``."""
+        ``DispatchPlan.run`` / ``_run_segmented``.
+
+        ``mem`` (obs.memprof.MemoryProfiler, optional): the compiled path
+        has no per-task host boundaries, so its memory events are the
+        build-time model — per-node param slabs, per-node input staging,
+        the static per-exchange transfer table, and the final output —
+        recorded once per run (labels replace across reps)."""
         t0 = time.perf_counter()
         if self._single_device is not None:
             x = jax.device_put(graph_input, self._single_device)
@@ -614,6 +636,25 @@ class CompiledSchedule:
         if metrics is not None:
             metrics.counter("compiled.launches").inc(n_disp)
             metrics.counter("compiled.exchanges").inc(self.transfer_edges)
+        if mem is not None:
+            # recorded after the phase windows close so stage_s/launch_s
+            # stay clean; sizes are the static build-time tables
+            # mesh staging broadcasts: each device holds one row, so the
+            # per-device input footprint equals the host input's bytes
+            in_bytes = sum(
+                np.asarray(l).nbytes
+                for l in jax.tree_util.tree_leaves(graph_input)
+            )
+            for node in self.ir.devices:
+                pb = self.param_bytes_per_node.get(node, 0)
+                if pb:
+                    mem.alloc(node, "slab:params", pb, "params")
+                mem.alloc(node, "input", in_bytes, "activations")
+            for dst, tid, nb in self._exchange_table:
+                mem.alloc(dst, f"xfer:{tid}", nb, "transfers")
+            if self._final_out:
+                ftid, owner, nb = self._final_out
+                mem.alloc(owner, f"out:{ftid}", nb, "activations")
         phases = {
             "loop_s": t_launch - t0,
             "stage_s": t_stage - t0,

@@ -334,6 +334,7 @@ class PagedDecodeEngine:
         tracer: Any = None,
         metrics: Any = None,
         clock: Any = None,
+        memprof: Any = None,
     ):
         import numpy as np
 
@@ -393,6 +394,19 @@ class PagedDecodeEngine:
         self._clock = clock if clock is not None else time.perf_counter
         self._submit_t: Dict[Any, float] = {}     # rid -> submit() time
         self._first_tok_t: Dict[Any, float] = {}  # rid -> first-token time
+        # memory doctor: per-request KV page occupancy folds onto the
+        # profiler's timeline as kv_pages-bucket allocations (born at
+        # admission, freed at retirement) sized by the physical page —
+        # page_size rows x (Hkv, hd) x k+v x n_layers.  Explicit only;
+        # None costs nothing (every record below is None-guarded).
+        self.memprof = memprof
+        self._page_bytes = (
+            n_layers * 2 * pool.page_size * n_kv * hd
+            * np.dtype(config.dtype).itemsize
+        )
+        # the pools are one placed slab: attribute kv pages to the node
+        # the schedule put the decode step on
+        self._mem_node = next(iter(schedule.placement.values()), "node0")
 
     def reset(self) -> None:
         """Fresh pool/table/queue state, compiled programs kept.
@@ -403,9 +417,13 @@ class PagedDecodeEngine:
         from ..models.kv_pages import TRASH_PAGE, init_paged_kv
 
         np = self._np
-        for pages in self._slot_pages:
+        for s, pages in enumerate(self._slot_pages):
             if pages:
                 self.pool.free(pages)
+                if self.memprof is not None:
+                    self.memprof.free(
+                        self._mem_node, f"kv:{self._slot_req[s]}"
+                    )
         n_layers = self.n_layers
         n_kv, hd = self.pools["cache_k_0"].shape[2:]
         self.pools = init_paged_kv(
@@ -540,10 +558,15 @@ class PagedDecodeEngine:
                 (len(batch), self.pages_per_seq), TRASH_PAGE, self._np.int32
             )
             page_lists = []
-            for j, (_, _, _, need) in enumerate(batch):
+            for j, (rid, _, _, need) in enumerate(batch):
                 pages = self.pool.alloc(need)
                 page_lists.append(pages)
                 pt_rows[j, :need] = pages
+                if self.memprof is not None:
+                    self.memprof.alloc(
+                        self._mem_node, f"kv:{rid}",
+                        need * self._page_bytes, "kv_pages",
+                    )
             t_pf0 = self._clock() if self.tracer is not None else 0.0
             first = self._prefill_scatter(
                 jnp.concatenate([ids for _, ids, _, _ in batch], axis=0),
@@ -593,6 +616,8 @@ class PagedDecodeEngine:
     def _retire(self, s: int) -> None:
         rid = self._slot_req[s]
         self.pool.free(self._slot_pages[s])
+        if self.memprof is not None:
+            self.memprof.free(self._mem_node, f"kv:{rid}")
         self.results[rid] = self._np.asarray(
             self._tokens.pop(rid), dtype=self._np.int32
         )

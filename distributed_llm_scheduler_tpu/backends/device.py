@@ -104,6 +104,10 @@ class DeviceReport:
     # (obs/attribution.py) over this execute's span window — makespan
     # split into compute/transfer/dispatch/idle plus stragglers/bubbles
     attribution: Optional[Dict[str, Any]] = None
+    # memprof runs only: the memory doctor's per-device timeline summary
+    # (obs/memprof.py) — peaks, watermark attribution buckets, and
+    # platform reconciliation where memory_stats() reported
+    memory: Optional[Dict[str, Any]] = None
 
     @property
     def total_param_gb_placed(self) -> float:
@@ -145,6 +149,11 @@ class DeviceReport:
             **(
                 {"attribution": self.attribution}
                 if self.attribution is not None
+                else {}
+            ),
+            **(
+                {"memory": self.memory}
+                if self.memory is not None
                 else {}
             ),
         }
@@ -258,6 +267,7 @@ class DeviceBackend:
         graph: TaskGraph,
         schedule: Schedule,
         params: Dict[str, Any],
+        mem: Any = None,
     ) -> Tuple[Dict[Tuple[str, str], Any], Dict[str, int]]:
         """Put each param onto every device that runs a task needing it.
 
@@ -275,7 +285,10 @@ class DeviceBackend:
                 key = (p, node_id)
                 if key not in placed:
                     placed[key] = jax.device_put(params[p], dev)
-                    bytes_per_node[node_id] += _array_bytes(params[p])
+                    nb = _array_bytes(params[p])
+                    bytes_per_node[node_id] += nb
+                    if mem is not None:
+                        mem.alloc(node_id, f"param:{p}", nb, "params")
         # placed values may be pytrees (e.g. QParam int8+scale pairs), so
         # use the pytree-aware barrier
         jax.block_until_ready(list(placed.values()))
@@ -324,9 +337,13 @@ class DeviceBackend:
             params: Dict[str, Any],
             plan: Optional[Dict[str, List[Tuple[str, Tuple[str, ...]]]]] = None,
             lookahead: int = 8,
+            mem: Any = None,
         ):
             self.cluster = cluster
             self.host_params = params
+            # optional obs/memprof recorder: loads are param births,
+            # graveyard flushes are the matching frees
+            self.mem = mem
             self.resident: Dict[str, Dict[str, Any]] = {
                 d.node_id: {} for d in cluster
             }
@@ -358,7 +375,7 @@ class DeviceBackend:
             self.last_consumer: Dict[str, Dict[str, Tuple[int, Any]]] = {
                 d.node_id: {} for d in cluster
             }
-            self.graveyard: Dict[str, List[Tuple[int, Any, Any, int]]] = {
+            self.graveyard: Dict[str, List[Tuple[int, Any, Any, int, str]]] = {
                 d.node_id: [] for d in cluster
             }
             self.loads = 0
@@ -397,7 +414,7 @@ class DeviceBackend:
             g.sort(key=lambda e: e[0])
             freed = 0
             while g and freed < need_bytes:
-                step, out, arr, nbytes = g.pop(0)
+                step, out, arr, nbytes, name = g.pop(0)
                 if step > self.fenced_step[node_id] and out is not None:
                     jax.block_until_ready(out)
                     self.fenced_step[node_id] = step
@@ -405,6 +422,8 @@ class DeviceBackend:
                     leaf.delete()
                 self.bytes[node_id] -= nbytes
                 freed += nbytes
+                if self.mem is not None:
+                    self.mem.free(node_id, f"param:{name}")
             return freed
 
         def _evict_one(
@@ -435,7 +454,7 @@ class DeviceBackend:
             step, out = self.last_consumer[node_id].pop(victim, (0, None))
             nbytes = _array_bytes(arr)
             # bytes stay on the ledger until _flush deletes the buffer
-            self.graveyard[node_id].append((step, out, arr, nbytes))
+            self.graveyard[node_id].append((step, out, arr, nbytes, victim))
             self.evictions += 1
             return nbytes
 
@@ -466,6 +485,8 @@ class DeviceBackend:
                 self.load_bytes += nb
                 self.loads += 1
                 self.last_use[node_id][n] = self._step
+                if self.mem is not None:
+                    self.mem.alloc(node_id, f"param:{n}", nb, "params")
             self.peak[node_id] = max(self.peak[node_id], self.bytes[node_id])
 
         def _ensure(
@@ -916,6 +937,7 @@ class DeviceBackend:
         order: Optional[List[str]] = None,
         tracer: Any = None,
         metrics: Any = None,
+        mem: Any = None,
     ) -> Tuple[
         Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any],
         Dict[str, float],
@@ -1019,6 +1041,10 @@ class DeviceBackend:
                                     f"{placement.get(d, 'ext')}->{node}",
                                     unit="bytes",
                                 ).inc(nb)
+                            if mem is not None:
+                                mem.alloc(
+                                    node, f"xfer:{d}", nb, "transfers"
+                                )
                         ext[d] = x
             if streamer is not None:
                 union = streamer.get_task(
@@ -1031,8 +1057,19 @@ class DeviceBackend:
                 }
             if needs_input:
                 ext["__input__"] = jax.device_put(graph_input, dev)
+                if mem is not None:
+                    mem.alloc(
+                        node, "input", _array_bytes(graph_input),
+                        "activations",
+                    )
             fn = self._segment_callable(graph, tids, exports, rebatch)
             seg_out = fn(union, ext)
+            if mem is not None:
+                for e in exports:
+                    mem.alloc(
+                        node, f"out:{e}", _array_bytes(seg_out[e]),
+                        "activations",
+                    )
             if tracer is not None:
                 t_s1 = time.perf_counter()
                 tracer.complete(
@@ -1098,6 +1135,7 @@ class DeviceBackend:
         order: Optional[List[str]] = None,
         tracer: Any = None,
         metrics: Any = None,
+        mem: Any = None,
     ) -> Tuple[
         Any, Dict[str, TaskTiming], int, int, int, int, Dict[str, Any],
         Dict[str, float],
@@ -1166,12 +1204,21 @@ class DeviceBackend:
                                 f"{placement.get(d, 'ext')}->{node_id}",
                                 unit="bytes",
                             ).inc(nb)
+                        if mem is not None:
+                            mem.alloc(
+                                node_id, f"xfer:{d}", nb, "transfers"
+                            )
                     args.append(x)
             else:
                 inp = input_on.get(node_id)
                 if inp is None:
                     inp = jax.device_put(graph_input, dev)
                     input_on[node_id] = inp
+                    if mem is not None:
+                        mem.alloc(
+                            node_id, "input", _array_bytes(graph_input),
+                            "activations",
+                        )
                 args = [inp]
 
             fn = self._jitted(graph, tid)
@@ -1205,6 +1252,10 @@ class DeviceBackend:
                             src=d, dst=tid, bytes=nb,
                         )
             outputs[tid] = out
+            if mem is not None:
+                mem.alloc(
+                    node_id, f"out:{tid}", _array_bytes(out), "activations"
+                )
             if streamer is not None:
                 streamer.note_task(
                     node_id, [g for _, g in task.param_items()], out
@@ -1257,6 +1308,7 @@ class DeviceBackend:
         trace: Any = None,
         metrics: Any = None,
         clock: Any = None,
+        memprof: Any = None,
     ):
         """Continuous-batching paged decode engine over a SCHEDULED paged
         decode-step DAG (``frontend.build_paged_decode_dag``).
@@ -1280,7 +1332,7 @@ class DeviceBackend:
         return PagedDecodeEngine(
             graph, schedule, config, weights, pool,
             slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
-            tracer=trace, metrics=metrics, clock=clock,
+            tracer=trace, metrics=metrics, clock=clock, memprof=memprof,
         )
 
     def execute(
@@ -1305,6 +1357,7 @@ class DeviceBackend:
         fence_rtt: Optional[float] = None,
         trace: Any = None,
         metrics: Any = None,
+        memprof: Any = None,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
@@ -1423,6 +1476,15 @@ class DeviceBackend:
         ``None`` (the default) falls back to the ambient pair when
         ``DLS_TRACE=1`` is set, else recording is fully disabled (the
         hot paths guard every record behind a ``None`` check).
+
+        ``memprof`` attaches an :class:`..obs.memprof.MemoryProfiler`:
+        the run records param staging / slab construction, task-output
+        births, donation-driven frees, transfer copies, and input
+        staging as allocation events on per-device timelines, and the
+        report carries ``memory`` (the profiler summary, platform
+        ``memory_stats()`` peaks reconciled in where reported).  Warmup
+        runs unrecorded, same as the tracer — only the timed reps land
+        on the timeline.  Explicit only (no ambient fallback).
         """
         if segments and profile:
             raise ValueError(
@@ -1562,7 +1624,9 @@ class DeviceBackend:
             placed, bytes_per_node = {}, {}
         else:
             t_ph = time.perf_counter() if tracer is not None else 0.0
-            placed, bytes_per_node = self.place_params(graph, schedule, params)
+            placed, bytes_per_node = self.place_params(
+                graph, schedule, params, mem=memprof
+            )
             if tracer is not None:
                 tracer.complete(
                     "place_params", t_ph, time.perf_counter(),
@@ -1686,7 +1750,7 @@ class DeviceBackend:
         streamer = (
             self._ParamStreamer(
                 self.cluster, params, plan=stream_plan,
-                lookahead=stream_lookahead,
+                lookahead=stream_lookahead, mem=memprof,
             )
             if stream_params else None
         )
@@ -1702,6 +1766,7 @@ class DeviceBackend:
                     touts, phases,
                 ) = prog.run(
                     graph_input, fence=fence, tracer=tracer, metrics=mreg,
+                    mem=memprof,
                 )
             elif plan is not None:
                 (
@@ -1709,7 +1774,7 @@ class DeviceBackend:
                     touts, phases,
                 ) = plan.run(
                     graph_input, ext_outputs, fence=fence,
-                    tracer=tracer, metrics=mreg,
+                    tracer=tracer, metrics=mreg, mem=memprof,
                 )
             elif segments:
                 (
@@ -1719,7 +1784,7 @@ class DeviceBackend:
                     graph, schedule, placed, graph_input, ext_outputs,
                     fence=fence, rebatch=rebatch, streamer=streamer,
                     segments_pre=segments_pre, order=order_once,
-                    tracer=tracer, metrics=mreg,
+                    tracer=tracer, metrics=mreg, mem=memprof,
                 )
             else:
                 (
@@ -1728,7 +1793,7 @@ class DeviceBackend:
                 ) = self._run(
                     graph, schedule, placed, graph_input, profile,
                     ext_outputs, streamer, fence=fence, order=order_once,
-                    tracer=tracer, metrics=mreg,
+                    tracer=tracer, metrics=mreg, mem=memprof,
                 )
             loop_s_total += phases.get("loop_s", 0.0)
             for k, v in phases.items():
@@ -1752,6 +1817,10 @@ class DeviceBackend:
                     peaks[d.node_id] = int(stats["peak_bytes_in_use"])
             except Exception:
                 pass
+        if memprof is not None:
+            # platform truth where PJRT reports it; the profiler's
+            # model-derived timeline stands alone elsewhere
+            memprof.reconcile(peaks)
 
         if timings:
             schedule.timings = timings
@@ -1825,4 +1894,5 @@ class DeviceBackend:
             param_evictions=streamer.evictions if streamer else 0,
             peak_param_bytes=dict(streamer.peak) if streamer else {},
             attribution=attribution,
+            memory=memprof.summary() if memprof is not None else None,
         )

@@ -318,8 +318,10 @@ class PlanStep:
         "n_edges",       # transfer edges this launch contributes (static)
         "xfer_bytes",    # per-run transferred bytes; filled on first run
         "donate_slots",  # slots whose ORIGINAL buffer this launch consumes
+        "donate_tids",   # producer task id per donate slot (memprof frees)
         "donate_argnums",  # jit donate positions (params dict is argument 0)
         "out_slots",     # value-table indices written (exports, in order)
+        "out_tids",      # exported task id per out_slot (memprof births)
         "group",         # True => fn returns a tuple aligned with out_slots
     )
 
@@ -460,6 +462,11 @@ class DispatchPlan:
         task_out_slots = set(
             slot_of[t] for exports in exports_of for t in exports
         )
+        # reverse map for the memory profiler's donation frees (a donated
+        # slot's dying buffer is its producer task's ``out:`` label)
+        tid_of_slot = {
+            slot_of[t]: t for exports in exports_of for t in exports
+        }
         protected = {final_slot} | {s for _, s in fence_slots}
 
         steps: List[PlanStep] = []
@@ -543,11 +550,13 @@ class DispatchPlan:
             step.n_edges = len(xfer_map)
             step.xfer_bytes = None if xfer_map else 0
             step.donate_slots = tuple(donate_slots)
+            step.donate_tids = tuple(tid_of_slot[s] for s in donate_slots)
             step.donate_argnums = donate_argnums
             step.group = len(g) > 1
             if step.group:
                 exports = exports_of[gi]
                 step.out_slots = tuple(slot_of[t] for t in exports)
+                step.out_tids = exports
                 step.fn = backend._grouped_jitted(
                     graph, tuple(g), exports, donate_argnums
                 )
@@ -558,6 +567,7 @@ class DispatchPlan:
                 }
             else:
                 step.out_slots = (slot_of[g[0]],)
+                step.out_tids = (g[0],)
                 step.fn = backend._jitted(graph, g[0], donate_argnums)
                 step.pd = {
                     loc: placed_params[(glob, node)]
@@ -652,6 +662,7 @@ class DispatchPlan:
         fence: bool = True,
         tracer: Any = None,
         metrics: Any = None,
+        mem: Any = None,
     ) -> Tuple[Any, Dict, int, int, int, int, Dict[str, Any], Dict[str, float]]:
         """Execute the plan once.  Same return contract as the legacy
         runners plus a phase dict: ``(final, timings, transfer_edges,
@@ -667,7 +678,12 @@ class DispatchPlan:
         Both default to None and every instrumentation point is behind a
         None check — the disabled hot loop is the PR 2 fast path
         unchanged (the <2% regression budget is measured by
-        ``eval/dispatch_bench.py``)."""
+        ``eval/dispatch_bench.py``).
+
+        ``mem`` (obs.memprof.MemoryProfiler, optional): records input
+        staging, transfer copies, task-output births, and donation-driven
+        frees (the lifetimes :meth:`donation_table` documents) onto the
+        per-device timelines."""
         vals: List[Any] = [None] * self.n_slots
         done: Optional[Dict[str, Tuple[str, float]]] = (
             {} if tracer is not None else None
@@ -681,6 +697,10 @@ class DispatchPlan:
             t0 = time.perf_counter()
             for _n, dev, s in self.input_slots:
                 vals[s] = jax.device_put(graph_input, dev)
+                if mem is not None:
+                    mem.alloc(
+                        _n, "input", _array_bytes(vals[s]), "activations"
+                    )
             stage_s += time.perf_counter() - t0
             if tracer is not None:
                 tracer.complete(
@@ -698,7 +718,7 @@ class DispatchPlan:
                     step.xfer_bytes = sum(
                         _array_bytes(srcs[ui]) for _p, ui in step.xfer_map
                     )
-                if metrics is not None:
+                if metrics is not None or mem is not None:
                     per_edge = [_array_bytes(x) for x in srcs]
                 t0 = time.perf_counter()
                 if step.xfer_avals and _fast_put is not None:
@@ -732,6 +752,12 @@ class DispatchPlan:
                             f"transfer.bytes.{src_node}->{step.node_id}",
                             unit="bytes",
                         ).inc(per_edge[ui])
+                if mem is not None:
+                    for ui, src in enumerate(step.xfer_src_tids):
+                        mem.alloc(
+                            step.node_id, f"xfer:{src}", per_edge[ui],
+                            "transfers",
+                        )
                 for pos, ui in step.xfer_map:
                     args[pos] = moved[ui]
             else:
@@ -745,6 +771,16 @@ class DispatchPlan:
                     vals[s] = o
             else:
                 vals[step.out_slots[0]] = step.fn(step.pd, *args)
+            if mem is not None:
+                # births, then the donation-consumed producers' deaths —
+                # the exact lifetimes donation_table() documents
+                for t, s in zip(step.out_tids, step.out_slots):
+                    mem.alloc(
+                        step.node_id, f"out:{t}", _array_bytes(vals[s]),
+                        "activations",
+                    )
+                for t in step.donate_tids:
+                    mem.free(step.node_id, f"out:{t}")
             if tracer is not None:
                 t_l1 = time.perf_counter()
                 name = (

@@ -540,6 +540,19 @@ def promote_snapshot_headline(
     return promoted
 
 
+def modeled_kv_pages_peak(
+    slots: int, prompt_len: int, max_new: int, page_size: int
+) -> int:
+    """Modeled steady-state KV page-pool peak for a paged decode leg:
+    every slot busy with a full-horizon request, i.e. ``slots x
+    pages_needed(prompt + max_new, page_size)``.  Pure host arithmetic
+    over the pool geometry (``models.kv_pages.pages_needed``) — fully
+    deterministic, so the regress gate can hold it to zero tolerance."""
+    from ..models.kv_pages import pages_needed
+
+    return slots * pages_needed(prompt_len + max_new, page_size)
+
+
 @dataclass
 class BenchResult:
     """Everything the bench prints; ``to_json`` is THE one stdout line."""
@@ -553,6 +566,13 @@ class BenchResult:
     fallback: bool = False
     peak_hbm_gb_measured: Optional[float] = None
     peak_hbm_gb_modeled: Optional[float] = None
+    # memory doctor (regression surface): per-device modeled peak bytes
+    # from the winning schedule's no-evict replay, emitted flattened as
+    # ``peak_hbm_bytes.<node>`` so the regress gate tracks each device
+    # (max-only hid single-device placement shifts); and the modeled
+    # steady-state KV page-pool peak of the decode leg's geometry
+    peak_hbm_bytes: Optional[Dict[str, int]] = None
+    kv_pages_peak: Optional[int] = None
     mfu_single_chip: Optional[float] = None
     dispatch_overhead: Optional[float] = None
     link_provenance: Optional[str] = None
@@ -633,6 +653,13 @@ class BenchResult:
             out["peak_hbm_gb_measured"] = round(self.peak_hbm_gb_measured, 3)
         if self.peak_hbm_gb_modeled is not None:
             out["peak_hbm_gb_modeled"] = round(self.peak_hbm_gb_modeled, 3)
+        if self.peak_hbm_bytes is not None:
+            for node in sorted(self.peak_hbm_bytes):
+                out[f"peak_hbm_bytes.{node}"] = int(
+                    self.peak_hbm_bytes[node]
+                )
+        if self.kv_pages_peak is not None:
+            out["kv_pages_peak"] = int(self.kv_pages_peak)
         if self.mfu_single_chip is not None:
             out["mfu_single_chip"] = round(self.mfu_single_chip, 4)
         if self.dispatch_overhead is not None:

@@ -36,9 +36,26 @@ LOWER_BETTER = (
     "fused_scalar_ms",
     "dispatch_overhead",
     "peak_hbm_gb_modeled",
+    "kv_pages_peak",
     "singlechip_replay_ms",
     "fence_rtt_ms",
 )
+
+# lower-is-better metric FAMILIES, matched by prefix: per-device peak
+# HBM appears flattened as ``peak_hbm_bytes.<node>`` (one metric per
+# device), so direction cannot be an exact-name lookup
+LOWER_BETTER_PREFIXES = ("peak_hbm_bytes",)
+
+# per-metric default tolerances, consulted before ``default_tolerance``:
+# modeled memory metrics are deterministic given the committed cost
+# caches, so they get a tight band — a placement change that moves a
+# device's peak by >2% should be a deliberate baseline recapture, not
+# ambient noise
+METRIC_DEFAULT_TOLERANCES = {
+    "peak_hbm_gb_modeled": 0.02,
+    "peak_hbm_bytes": 0.02,
+    "kv_pages_peak": 0.0,
+}
 HIGHER_BETTER = (
     "vs_baseline",
     "mfu_single_chip",
@@ -57,6 +74,7 @@ DEFAULT_METRICS = (
     "compiled_makespan_ms",
     "dispatch_overhead",
     "peak_hbm_gb_modeled",
+    "kv_pages_peak",
     "mfu_single_chip",
     "mfu_segmented",
     "mfu_compiled",
@@ -163,7 +181,17 @@ def _direction(metric: str) -> Optional[str]:
         return "lower"
     if metric in HIGHER_BETTER:
         return "higher"
+    family = metric.split(".", 1)[0]
+    if family in LOWER_BETTER_PREFIXES:
+        return "lower"
     return None
+
+
+def _default_tol(metric: str, fallback: float) -> float:
+    tol = METRIC_DEFAULT_TOLERANCES.get(metric)
+    if tol is None:
+        tol = METRIC_DEFAULT_TOLERANCES.get(metric.split(".", 1)[0])
+    return fallback if tol is None else tol
 
 
 def compare_artifacts(
@@ -195,7 +223,9 @@ def compare_artifacts(
         if m not in baseline:
             continue
         base = baseline[m]
-        tol = float(tolerances.get(m, default_tolerance))
+        tol = float(
+            tolerances.get(m, _default_tol(m, default_tolerance))
+        )
         if m not in fresh or fresh[m] is None:
             checks.append(MetricCheck(m, direction, base, None, tol,
                                       "missing"))
@@ -248,6 +278,8 @@ __all__ = [
     "DEFAULT_TOLERANCE",
     "HIGHER_BETTER",
     "LOWER_BETTER",
+    "LOWER_BETTER_PREFIXES",
+    "METRIC_DEFAULT_TOLERANCES",
     "MetricCheck",
     "RegressVerdict",
     "compare_artifacts",

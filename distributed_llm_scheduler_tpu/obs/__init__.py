@@ -11,7 +11,11 @@ subsystems (planned dispatch, segment fusion, paged decode):
   unified timeline or a timed schedule;
 * :mod:`.attribution` — the run doctor's measured critical-path
   reconstruction and compute/transfer/dispatch/idle makespan split;
-* :mod:`.drift` — per-task predicted-vs-measured cost-model audit.
+* :mod:`.drift` — per-task predicted-vs-measured cost-model audit;
+* :mod:`.memprof` — measured per-device HBM timelines with watermark
+  attribution (the memory half of the doctor);
+* :mod:`.memdrift` — measured-vs-predicted memory peaks, per device and
+  per task, with the near-OOM headroom warnings.
 
 Everything is opt-in.  Two ways to turn it on:
 
@@ -37,6 +41,8 @@ from typing import Optional
 
 from .attribution import Attribution, attribute_run, attribute_trace
 from .drift import DriftReport, compute_drift
+from .memdrift import MemDriftReport, compute_mem_drift
+from .memprof import MemoryProfiler
 from .metrics import MetricsRegistry
 from .trace import HOST_TRACK, Tracer
 
@@ -84,6 +90,8 @@ __all__ = [
     "Attribution",
     "DriftReport",
     "HOST_TRACK",
+    "MemDriftReport",
+    "MemoryProfiler",
     "MetricsRegistry",
     "Tracer",
     "ambient_metrics",
@@ -91,6 +99,7 @@ __all__ = [
     "attribute_run",
     "attribute_trace",
     "compute_drift",
+    "compute_mem_drift",
     "reset_ambient",
     "trace_enabled",
 ]

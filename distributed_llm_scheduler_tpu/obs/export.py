@@ -55,11 +55,19 @@ def _track_tids(tracer: Tracer) -> Dict[str, int]:
 
 def chrome_events(
     tracer: Tracer, process_name: str = "distributed_llm_scheduler_tpu",
+    memprof: Any = None,
 ) -> List[Dict[str, Any]]:
     """Render a tracer's event list as Chrome ``traceEvents``.
 
     Timestamps are normalized so the earliest recorded event sits at
-    ``ts=0`` (raw ``perf_counter`` epochs are meaningless absolute)."""
+    ``ts=0`` (raw ``perf_counter`` epochs are meaningless absolute).
+
+    ``memprof`` (a :class:`..obs.memprof.MemoryProfiler`) additionally
+    renders one ``mem.hbm_bytes.<device>`` counter track per device
+    from the profiler's timeline — for profilers constructed *without*
+    a tracer (one built with ``tracer=`` already emitted its samples
+    into the tracer's own event list, and passing it again here would
+    double every sample)."""
     tids = _track_tids(tracer)
     stamps: List[float] = []
     for ev in tracer.events:
@@ -69,6 +77,8 @@ def chrome_events(
             stamps.append(ev["t"])
         else:  # flow
             stamps.append(ev["src_ts"])
+    if memprof is not None:
+        stamps.extend(ev["t"] for ev in memprof.events)
     epoch = min(stamps) if stamps else 0.0
 
     out: List[Dict[str, Any]] = [{
@@ -119,15 +129,27 @@ def chrome_events(
                 "tid": tids[ev["dst_track"]],
                 "ts": (ev["dst_ts"] - epoch) * _US, "args": ev["args"],
             })
+    if memprof is not None:
+        from .memprof import COUNTER_PREFIX
+
+        for ev in memprof.events:
+            out.append({
+                "name": COUNTER_PREFIX + ev["device"], "ph": "C",
+                "pid": PID, "tid": 0,
+                "ts": (ev["t"] - epoch) * _US,
+                "args": {"value": ev["total"]},
+            })
     return out
 
 
 def export_perfetto(
     tracer: Tracer, path: str,
     process_name: str = "distributed_llm_scheduler_tpu",
+    memprof: Any = None,
 ) -> str:
     """Write a tracer's unified timeline to ``path``; returns ``path``."""
-    events = chrome_events(tracer, process_name=process_name)
+    events = chrome_events(tracer, process_name=process_name,
+                           memprof=memprof)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)

@@ -182,3 +182,45 @@ def validate_snapshot(snap: Any) -> List[str]:
                 if f not in row:
                     errs.append(f"{family}.{name} missing {f!r}")
     return errs
+
+
+def _num_delta(a: Any, b: Any) -> Optional[float]:
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return b - a
+    return None
+
+
+def diff_snapshots(a: Any, b: Any) -> Dict[str, Any]:
+    """Structured diff of two ``dls.metrics/1`` snapshots (the ``metrics
+    diff`` CLI): counter/gauge value deltas, histogram count and
+    p50/p95 quantile shifts, plus the names present on only one side.
+    Both inputs must validate — raises ``ValueError`` listing the first
+    problems otherwise (schema mismatch included)."""
+    for tag, snap in (("a", a), ("b", b)):
+        errs = validate_snapshot(snap)
+        if errs:
+            raise ValueError(
+                f"snapshot {tag} invalid: " + "; ".join(errs[:5])
+            )
+
+    out: Dict[str, Any] = {"schema": "dls.metrics-diff/1"}
+    for family, keys in (
+        ("counters", ("value",)),
+        ("gauges", ("value", "max")),
+        ("histograms", ("count", "sum", "mean", "p50", "p95")),
+    ):
+        ba, bb = a[family], b[family]
+        rows: Dict[str, Any] = {}
+        for name in sorted(set(ba) | set(bb)):
+            ra, rb = ba.get(name), bb.get(name)
+            if ra is None or rb is None:
+                rows[name] = {"only_in": "b" if ra is None else "a"}
+                continue
+            row: Dict[str, Any] = {}
+            for k in keys:
+                row[f"{k}_a"] = ra.get(k)
+                row[f"{k}_b"] = rb.get(k)
+                row[f"{k}_delta"] = _num_delta(ra.get(k), rb.get(k))
+            rows[name] = row
+        out[family] = rows
+    return out

@@ -25,6 +25,7 @@ BASE = {
     "compiled_makespan_ms": 75.0,
     "dispatch_overhead": 0.2,
     "peak_hbm_gb_modeled": 4.0,
+    "kv_pages_peak": 4,
     "mfu_single_chip": 0.30,
     "mfu_segmented": 0.25,
     "mfu_compiled": 0.28,
