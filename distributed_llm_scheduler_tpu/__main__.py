@@ -198,6 +198,9 @@ def cmd_lint(args) -> int:
         # happens-before pass itself regressed
         rep.extend(analyze_happens_before(stage_programs_1f1b(4, 8)))
         rep = rep.dedupe()
+        if args.json:
+            print(json.dumps(rep.to_json()))
+            return rep.exit_code
         min_sev = Severity.INFO if args.verbose else Severity.WARNING
         print(rep.render(min_severity=min_sev))
         return rep.exit_code
@@ -283,11 +286,18 @@ def cmd_lint(args) -> int:
         param_specs=param_specs if cfg.quantize == "int8" else None,
         compiled_gb=compiled_gb,
         analytic_gb=analytic_gb,
+        # typecheck (TYP001-TYP004) inputs: param *specs* carry the same
+        # avals as initialized weights without materializing any arrays
+        params=param_specs,
+        graph_input=getattr(dag, "input_spec", None),
     )
-    if schedule.failed:
+    if schedule.failed and not args.json:
         print(f"note: scheduler failed {len(schedule.failed)} task(s) "
               "under this memory regime (not a schedule defect)",
               file=sys.stderr)
+    if args.json:
+        print(json.dumps(rep.to_json()))
+        return rep.exit_code
     from .analysis import Severity
 
     min_sev = Severity.INFO if args.verbose else Severity.WARNING
@@ -1459,6 +1469,10 @@ def main(argv=None) -> int:
     p.add_argument("--verbose", action="store_true",
                    help="also print info-level diagnostics (per-node peak "
                         "residency)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as one machine-readable JSON "
+                        "object (schema dls.lint/1) on stdout instead of "
+                        "rendered text; exit codes unchanged")
     p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("sweep", help="full evaluation sweep (CSV+PNG)")

@@ -25,6 +25,7 @@ from ..core.graph import TaskGraph
 from ..core.schedule import Schedule
 from .diagnostics import (
     CODES,
+    JSON_SCHEMA,
     AnalysisError,
     AnalysisReport,
     Diagnostic,
@@ -41,18 +42,28 @@ from .donation_pass import analyze_donation
 from .fixes import fix_duplicate_dependencies, fix_per_node_order
 from .graph_pass import analyze_graph
 from .hb_pass import StageOp, analyze_happens_before, stage_programs_1f1b
-from .memory_pass import analyze_memory
+from .incremental import AnalysisDelta, IncrementalAnalyzer
+from .memory_pass import analyze_memory, node_memory_slice
 from .parallel_sweep import sweep_parallel_collectives
 from .pipeline_pass import analyze_pipeline
 from .quant_pass import analyze_quantization
 from .schedule_pass import analyze_schedule
 from .sharding_pass import analyze_sharding
+from .stream_pass import (
+    analyze_streaming,
+    compiled_stream_refusal,
+    stream_verdict,
+)
+from .typecheck_pass import analyze_typecheck
 
 __all__ = [
     "CODES",
+    "AnalysisDelta",
     "AnalysisError",
     "AnalysisReport",
     "Diagnostic",
+    "IncrementalAnalyzer",
+    "JSON_SCHEMA",
     "Severity",
     "StageOp",
     "analyze",
@@ -69,11 +80,16 @@ __all__ = [
     "analyze_quantization",
     "analyze_schedule",
     "analyze_sharding",
+    "analyze_streaming",
+    "analyze_typecheck",
+    "compiled_stream_refusal",
     "fix_duplicate_dependencies",
     "fix_per_node_order",
     "gate_enabled",
+    "node_memory_slice",
     "pre_execution_gate",
     "stage_programs_1f1b",
+    "stream_verdict",
     "sweep_parallel_collectives",
 ]
 
@@ -101,19 +117,30 @@ def analyze(
     analytic_gb: Optional[Dict[str, float]] = None,
     stage_programs: Optional[Dict[str, Any]] = None,
     plan: Optional[Any] = None,
+    params: Optional[Dict[str, Any]] = None,
+    graph_input: Any = None,
 ) -> AnalysisReport:
     """Run every pass the provided inputs make applicable.
 
-    Graph hygiene always runs; schedule-consistency, memory, and pipeline
-    passes run when ``cluster`` and ``schedule`` are given; the sharding
-    pass runs when ``param_shapes`` + ``mesh_axes`` are given; the
-    quantization pass runs when ``param_specs`` is given; the cost pass
-    runs when ``compiled_gb`` (an ``utils.hbm.preflight_task_memory``
-    result, with ``analytic_gb`` the pre-preflight snapshot) is given;
-    the MPMD happens-before pass runs when ``stage_programs`` (per-stage
-    op sequences, see :mod:`.hb_pass`) is given; the donation pass runs
-    when ``plan`` (a DispatchPlan/CompiledSchedule or their metadata
-    dict, see :mod:`.donation_pass`) is given.
+    Graph hygiene always runs; schedule-consistency, memory, pipeline,
+    typecheck (TYP001-TYP004, fed by ``params`` — concrete arrays or a
+    spec table — and ``graph_input`` when available), and stream-safety
+    (STR001-STR003) passes run when ``cluster`` and ``schedule`` are
+    given; the sharding pass runs when ``param_shapes`` + ``mesh_axes``
+    are given; the quantization pass runs when ``param_specs`` is given
+    (``param_specs`` also feeds the typecheck pass's QNT metadata); the
+    cost pass runs when ``compiled_gb`` (an
+    ``utils.hbm.preflight_task_memory`` result, with ``analytic_gb`` the
+    pre-preflight snapshot) is given; the MPMD happens-before pass runs
+    when ``stage_programs`` (per-stage op sequences, see
+    :mod:`.hb_pass`) is given; the donation pass runs when ``plan`` (a
+    DispatchPlan/CompiledSchedule or their metadata dict, see
+    :mod:`.donation_pass`) is given.
+
+    The returned report is stamped with ``schedule.signature()`` when a
+    schedule was analyzed, so it can be handed straight back to
+    :func:`pre_execution_gate` as ``precomputed=`` without re-running
+    the base passes.
     """
     rep = analyze_graph(graph)
     rep.extend(analyze_decode(graph, cluster, schedule))
@@ -121,6 +148,17 @@ def analyze(
         rep.extend(analyze_schedule(graph, cluster, schedule))
         rep.extend(analyze_memory(graph, cluster, schedule, strict=strict))
         rep.extend(analyze_pipeline(graph, schedule))
+        rep.extend(
+            analyze_typecheck(
+                graph,
+                cluster,
+                schedule,
+                params=params,
+                param_specs=param_specs,
+                graph_input=graph_input,
+            )
+        )
+        rep.extend(analyze_streaming(graph, cluster, schedule))
     if param_shapes is not None and mesh_axes is not None:
         rep.extend(
             analyze_sharding(
@@ -138,6 +176,8 @@ def analyze(
         rep.extend(analyze_happens_before(stage_programs))
     if plan is not None:
         rep.extend(analyze_donation(plan))
+    if schedule is not None:
+        rep.schedule_signature = schedule.signature()
     return rep
 
 
@@ -167,12 +207,23 @@ def pre_execution_gate(
     program: Optional[Any] = None,
     plan: Optional[Any] = None,
     stage_programs: Optional[Dict[str, Any]] = None,
+    precomputed: Optional[AnalysisReport] = None,
 ) -> Optional[AnalysisReport]:
     """Cheap (O(V+E)) corruption check run by the backends before work.
 
     Raises :class:`AnalysisError` when the schedule would corrupt this
     backend's execution; returns the (possibly empty) report otherwise,
     or ``None`` when the gate is disabled via ``DLS_SKIP_ANALYSIS``.
+
+    ``precomputed``: a report :func:`analyze` just produced for THIS
+    schedule — accepted, and the base passes skipped, only when its
+    stamped ``schedule_signature`` matches ``schedule.signature()`` (the
+    identity dispatch is a pure function of); on any mismatch the gate
+    silently falls back to running the passes itself.  Reports from
+    other sources (e.g. ``IncrementalAnalyzer.report``) must not be
+    passed here: they cover a narrower pass suite than the gate
+    filters.  Extras (``program`` / ``plan`` / ``stage_programs``)
+    still run fresh: the precomputed report predates those artifacts.
 
     ``program`` (compiled execution path): the lowered
     :class:`..sched.linearize.ProgramIR` — the collective-ordering pass
@@ -192,9 +243,21 @@ def pre_execution_gate(
     if not gate_enabled():
         return None
     codes = _GATE_CODES[backend]
-    rep = analyze_graph(graph)
-    rep.extend(analyze_decode(graph, cluster, schedule))
-    rep.extend(analyze_schedule(graph, cluster, schedule))
+    reused = (
+        precomputed is not None
+        and precomputed.schedule_signature is not None
+        and precomputed.schedule_signature == schedule.signature()
+    )
+    if reused:
+        # the caller just analyzed this exact scheduling decision: its
+        # diagnostics cover everything the base passes would re-derive
+        # (analyze()'s SCH004 permutation check subsumes the sim replay's
+        # unplaced-order scan)
+        rep = AnalysisReport(list(precomputed.diagnostics))
+    else:
+        rep = analyze_graph(graph)
+        rep.extend(analyze_decode(graph, cluster, schedule))
+        rep.extend(analyze_schedule(graph, cluster, schedule))
     if program is not None:
         rep.extend(analyze_collectives(program))
         codes = codes | {"COL001", "COL002", "COL004"}
@@ -205,18 +268,19 @@ def pre_execution_gate(
         rep.extend(analyze_happens_before(stage_programs))
         codes = codes | {"COL005", "COL006"}
     if backend == "sim":
-        rep.extend(analyze_pipeline(graph, schedule))
-        # the replay indexes placement[tid] for every ordered task
-        placed = {t for ts in schedule.per_node.values() for t in ts}
-        for tid in schedule.assignment_order:
-            if tid not in placed:
-                rep.add(
-                    "SCH004",
-                    Severity.ERROR,
-                    f"assignment_order task {tid!r} has no placement",
-                    task=tid,
-                )
-                break
+        if not reused:
+            rep.extend(analyze_pipeline(graph, schedule))
+            # the replay indexes placement[tid] for every ordered task
+            placed = {t for ts in schedule.per_node.values() for t in ts}
+            for tid in schedule.assignment_order:
+                if tid not in placed:
+                    rep.add(
+                        "SCH004",
+                        Severity.ERROR,
+                        f"assignment_order task {tid!r} has no placement",
+                        task=tid,
+                    )
+                    break
         codes = codes | {"SCH004"}
     gated = AnalysisReport(
         [d for d in rep.diagnostics if d.code in codes]

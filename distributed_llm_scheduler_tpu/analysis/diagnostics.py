@@ -93,6 +93,17 @@ CODES: Dict[str, str] = {
     "DON002": "buffer donated more than once (aliased donation)",
     "DON003": "donation crosses a transfer/collective boundary with a "
               "remote reader",
+    # -- schedule typechecking (typecheck_pass) -------------------------
+    "TYP001": "producer/consumer aval disagreement on a dependency edge",
+    "TYP002": "illegal dtype promotion across a quantized edge",
+    "TYP003": "edge aval bytes diverge from the cost-model charge",
+    "TYP004": "program fan-in unsatisfiable: argument not available "
+              "on device at dispatch",
+    # -- stream-safety prover (stream_pass) -----------------------------
+    "STR001": "streamed schedule is compilable as-is (params fit resident)",
+    "STR002": "streamed schedule compilable only with a pinned prefix",
+    "STR003": "streamed schedule is interpreter-only (must evict from "
+              "the first task)",
 }
 
 
@@ -143,11 +154,42 @@ class AnalysisError(ValueError):
         super().__init__(f"static analysis found {len(errs)} error(s): {shown}{more}")
 
 
+#: Schema tag for :meth:`AnalysisReport.to_json`.  Bump only on breaking
+#: changes to the emitted structure; consumers key on it.
+JSON_SCHEMA = "dls.lint/1"
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort conversion of a diagnostic ``data`` payload to plain
+    JSON types.  Sets become sorted lists, tuples become lists, numpy
+    scalars collapse via ``item()``, everything else unknown falls back
+    to ``repr``."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (frozenset, set)):
+        return sorted(_jsonable(v) for v in value)
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return _jsonable(item())
+        except Exception:
+            pass
+    return repr(value)
+
+
 @dataclass
 class AnalysisReport:
     """Aggregated diagnostics from one or more passes."""
 
     diagnostics: List[Diagnostic] = field(default_factory=list)
+    #: ``Schedule.signature()`` of the schedule this report analyzed, when
+    #: one was given — lets :func:`..pre_execution_gate` accept the report
+    #: as precomputed and skip re-running the base passes.
+    schedule_signature: Optional[tuple] = None
 
     def add(
         self,
@@ -222,6 +264,39 @@ class AnalysisReport:
             f"analysis: {n_err} error(s), {n_warn} warning(s), {n_info} info"
         )
         return "\n".join(lines)
+
+    def to_json(self) -> Dict[str, Any]:
+        """Machine-readable form of the report (schema ``dls.lint/1``).
+
+        Stable contract: top-level keys ``schema``, ``exit_code``,
+        ``counts`` (error/warning/info), and ``diagnostics`` — each entry
+        carrying ``code``, ``severity`` (lowercase string), ``message``,
+        the ``task``/``node``/``param`` provenance (null when absent) and
+        the sanitized ``data`` payload.  Exit-code semantics are identical
+        to :attr:`exit_code`; the ``lint --json`` CLI emits exactly this.
+        """
+        n_err, n_warn = len(self.errors), len(self.warnings)
+        return {
+            "schema": JSON_SCHEMA,
+            "exit_code": self.exit_code,
+            "counts": {
+                "error": n_err,
+                "warning": n_warn,
+                "info": len(self.diagnostics) - n_err - n_warn,
+            },
+            "diagnostics": [
+                {
+                    "code": d.code,
+                    "severity": str(d.severity),
+                    "message": d.message,
+                    "task": d.task,
+                    "node": d.node,
+                    "param": d.param,
+                    "data": _jsonable(d.data),
+                }
+                for d in self.diagnostics
+            ],
+        }
 
     def raise_if_errors(self) -> None:
         if self.errors:

@@ -38,6 +38,80 @@ def _param_sizes_gb(graph: TaskGraph) -> Dict[str, float]:
     return sizes
 
 
+def node_memory_slice(
+    graph: TaskGraph,
+    cluster: Cluster,
+    schedule: Schedule,
+    nid: str,
+    strict: bool = False,
+    *,
+    _placed: Dict[str, str] = None,
+    _sizes: Dict[str, float] = None,
+) -> AnalysisReport:
+    """MEM001/MEM002/MEM003 for one node.
+
+    Residency accumulates independently per node, so the diagnostics for
+    ``nid`` depend only on the tasks placed there — the property the
+    incremental engine (analysis/incremental.py) relies on to recompute
+    exactly two node slices after a ``move_task``.  :func:`analyze_memory`
+    is the union of these slices plus the schedule-independent MEM004.
+    """
+    rep = AnalysisReport()
+    sizes = _sizes if _sizes is not None else _param_sizes_gb(graph)
+
+    def gb(p: str) -> float:
+        return sizes.get(p, DEFAULT_PARAM_GB)
+
+    placed = (
+        _placed
+        if _placed is not None
+        else placement_of(graph, cluster, schedule, AnalysisReport())
+    )
+    cap = cluster[nid].total_memory
+    resident: Dict[str, float] = {}
+    peak = 0.0
+    for tid in schedule.assignment_order:
+        if placed.get(tid) != nid or tid not in graph:
+            continue
+        task = graph[tid]
+        own = task.memory_required + sum(
+            gb(p) for p in task.params_needed
+        )
+        if own > cap + _EPS:
+            rep.add(
+                "MEM003",
+                Severity.ERROR,
+                f"{tid!r} needs {own:.2f} GB alone but {nid} has "
+                f"{cap:.2f} GB",
+                task=tid,
+                node=nid,
+                data={"own_gb": own, "cap_gb": cap},
+            )
+        for p in task.params_needed:
+            resident.setdefault(p, gb(p))
+        now = sum(resident.values()) + task.memory_required
+        peak = max(peak, now)
+
+    rep.add(
+        "MEM001",
+        Severity.INFO,
+        f"{nid} peak no-evict residency {peak:.2f} GB "
+        f"of {cap:.2f} GB",
+        node=nid,
+        data={"peak_gb": peak},
+    )
+    if peak > cap + _EPS:
+        rep.add(
+            "MEM002",
+            Severity.ERROR if strict else Severity.WARNING,
+            f"{nid} peak no-evict residency {peak:.2f} GB exceeds "
+            f"{cap:.2f} GB",
+            node=nid,
+            data={"peak_gb": peak},
+        )
+    return rep
+
+
 def analyze_memory(
     graph: TaskGraph,
     cluster: Cluster,
@@ -46,9 +120,6 @@ def analyze_memory(
 ) -> AnalysisReport:
     rep = AnalysisReport()
     sizes = _param_sizes_gb(graph)
-
-    def gb(p: str) -> float:
-        return sizes.get(p, DEFAULT_PARAM_GB)
 
     # params that no device could ever hold alongside nothing else
     if len(cluster) > 0:
@@ -64,48 +135,11 @@ def analyze_memory(
                 )
 
     placed = placement_of(graph, cluster, schedule, AnalysisReport())
-    resident: Dict[str, Dict[str, float]] = {d.node_id: {} for d in cluster}
-    peak = {d.node_id: 0.0 for d in cluster}
-    for tid in schedule.assignment_order:
-        nid = placed.get(tid)
-        if nid is None or tid not in graph:
-            continue
-        task = graph[tid]
-        cap = cluster[nid].total_memory
-        own = task.memory_required + sum(
-            gb(p) for p in task.params_needed
-        )
-        if own > cap + _EPS:
-            rep.add(
-                "MEM003",
-                Severity.ERROR,
-                f"{tid!r} needs {own:.2f} GB alone but {nid} has "
-                f"{cap:.2f} GB",
-                task=tid,
-                node=nid,
-                data={"own_gb": own, "cap_gb": cap},
+    for d in cluster:
+        rep.extend(
+            node_memory_slice(
+                graph, cluster, schedule, d.node_id, strict,
+                _placed=placed, _sizes=sizes,
             )
-        for p in task.params_needed:
-            resident[nid].setdefault(p, gb(p))
-        now = sum(resident[nid].values()) + task.memory_required
-        peak[nid] = max(peak[nid], now)
-
-    for nid, pk in peak.items():
-        rep.add(
-            "MEM001",
-            Severity.INFO,
-            f"{nid} peak no-evict residency {pk:.2f} GB "
-            f"of {cluster[nid].total_memory:.2f} GB",
-            node=nid,
-            data={"peak_gb": pk},
         )
-        if pk > cluster[nid].total_memory + _EPS:
-            rep.add(
-                "MEM002",
-                Severity.ERROR if strict else Severity.WARNING,
-                f"{nid} peak no-evict residency {pk:.2f} GB exceeds "
-                f"{cluster[nid].total_memory:.2f} GB",
-                node=nid,
-                data={"peak_gb": pk},
-            )
     return rep

@@ -146,6 +146,7 @@ class CompiledSchedule:
         graph_input: Any,
         donate: bool = False,
         pre_analysis: bool = True,
+        pre_report: Any = None,
     ) -> "CompiledSchedule":
         """Lower ``schedule`` over ``backend``'s cluster.
 
@@ -171,7 +172,7 @@ class CompiledSchedule:
         if pre_analysis and gate_enabled():
             pre_execution_gate(
                 graph, backend.cluster, schedule, backend="device",
-                program=ir,
+                program=ir, precomputed=pre_report,
             )
         if not ir.order:
             raise ValueError(

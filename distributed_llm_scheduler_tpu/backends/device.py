@@ -1358,6 +1358,7 @@ class DeviceBackend:
         trace: Any = None,
         metrics: Any = None,
         memprof: Any = None,
+        pre_report: Any = None,
     ) -> DeviceReport:
         """Place params, compile, run, measure.
 
@@ -1384,9 +1385,20 @@ class DeviceBackend:
         collective-ordering gate; a schedule whose per-node orders admit
         no global collective order raises (COL002) instead of silently
         re-linearizing.  Incompatible with every per-task feature
-        (``profile``/``stream_params``/``segments``/``coalesce``/
-        ``keep_outputs``/``ext_outputs``) — see docs/ARCHITECTURE.md's
-        execution ladder for when to pick which rung.
+        (``profile``/``segments``/``coalesce``/``keep_outputs``/
+        ``ext_outputs``) — see docs/ARCHITECTURE.md's execution ladder
+        for when to pick which rung.  ``stream_params`` composes via the
+        static stream-safety prover (analysis/stream_pass.py): when
+        every node's param union fits its HBM budget (STR001 on all
+        nodes) the run compiles as-is — the resident slab subsumes the
+        streaming plan — otherwise the call raises ``AnalysisError``
+        carrying the per-node STR002/STR003 diagnosis instead of the
+        historical blanket refusal.
+
+        ``pre_report``: a report ``analysis.analyze()`` just produced
+        for this exact (graph, schedule) — the pre-execution gate then
+        skips re-running its base passes (accepted only when the
+        report's stamped schedule signature matches).
 
         ``fence_rtt`` supplies a pre-calibrated fence round-trip
         (seconds) instead of re-probing it inside this call — callers
@@ -1491,12 +1503,31 @@ class DeviceBackend:
                 "profile=True needs per-task dispatch; run without segments"
             )
         if compiled:
+            if stream_params:
+                # historically an unconditional refusal; now the static
+                # stream-safety prover (analysis/stream_pass.py) decides:
+                # a schedule whose per-node param unions fit their HBM
+                # budgets compiles as-is — the resident slab load IS the
+                # whole residency plan — while anything that would need
+                # eviction stays on the interpreted streaming rung and is
+                # refused with the per-node STR diagnosis attached
+                from ..analysis import (
+                    AnalysisError,
+                    analyze_streaming,
+                    compiled_stream_refusal,
+                    stream_verdict,
+                )
+
+                srep = analyze_streaming(graph, self.cluster, schedule)
+                if stream_verdict(srep) != "compilable":
+                    raise AnalysisError(compiled_stream_refusal(srep))
+                stream_params = False
             # the whole run is ONE XLA program: there are no per-task
             # boundaries to time/stream/retain, no host-mediated segments,
             # and external values would have to be program inputs
             incompatible = [
                 name for name, flag in (
-                    ("profile", profile), ("stream_params", stream_params),
+                    ("profile", profile),
                     ("segments", segments), ("coalesce", coalesce),
                     ("keep_outputs", keep_outputs),
                     ("ext_outputs", ext_outputs is not None),
@@ -1545,11 +1576,14 @@ class DeviceBackend:
             )
         if self.pre_analysis and not compiled:
             # the compiled path gates inside CompiledSchedule.build with
-            # the lowered program attached (COL00x joins the checks)
+            # the lowered program attached (COL00x joins the checks).
+            # ``pre_report``: a fresh ``analyze()`` report for this exact
+            # schedule skips the duplicate base passes (signature-checked)
             from ..analysis import pre_execution_gate
 
             pre_execution_gate(
-                graph, self.cluster, schedule, backend="device"
+                graph, self.cluster, schedule, backend="device",
+                precomputed=pre_report,
             )
         graph.freeze()
         no_fn = [t.task_id for t in graph if t.fn is None]
@@ -1653,6 +1687,7 @@ class DeviceBackend:
             prog = CompiledSchedule.build(
                 self, graph, schedule, params, graph_input,
                 donate=donate, pre_analysis=self.pre_analysis,
+                pre_report=pre_report,
             )
             bytes_per_node = prog.param_bytes_per_node
             if tracer is not None:

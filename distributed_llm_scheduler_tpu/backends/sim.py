@@ -22,7 +22,7 @@ reference does, so hit-rate numbers are comparable across modes.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Set
+from typing import Any, Dict, Optional, Set
 
 from ..core.cluster import Cluster
 from ..core.graph import TaskGraph
@@ -255,11 +255,18 @@ class SimulatedBackend:
         schedule: Schedule,
         dag_type: str = "unknown",
         memory_regime: float = 1.0,
+        pre_report: Any = None,
     ) -> ExecutionReport:
         if self.pre_analysis:
+            # pre_report: a fresh ``analysis.analyze()`` report for this
+            # exact schedule lets the gate skip duplicate base passes
+            # (signature-checked inside pre_execution_gate)
             from ..analysis import pre_execution_gate
 
-            pre_execution_gate(graph, cluster, schedule, backend="sim")
+            pre_execution_gate(
+                graph, cluster, schedule, backend="sim",
+                precomputed=pre_report,
+            )
         placement = schedule.placement
         speeds = {d.node_id: d.compute_speed for d in cluster}
 

@@ -37,13 +37,97 @@ union of its groups' params plus the largest single-task activation.
 from __future__ import annotations
 
 import random
-from typing import Dict, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..backends.sim import LinkModel
+from ..core.schedule import Schedule
 from .base import SchedulerRun
 from .eventsim import simulate_placement
 from .pack import GroupPackScheduler
 from .pipeline import _group_stats
+
+
+class _StaticMoveFilter:
+    """Incremental static-analysis pre-filter for candidate placements.
+
+    Mirrors the search's group -> device assignment into a placed
+    :class:`Schedule` tracked by :class:`..analysis.IncrementalAnalyzer`.
+    A candidate whose delta-recheck introduces ERROR diagnostics beyond
+    the seed baseline (memory overcommit ``fits()`` under-models,
+    placement-dependent typecheck breakage) is rejected *before* the
+    eventsim replay is paid for.  Only active when the analyzer's exact
+    fast path holds — a dirty baseline would force a full re-analysis
+    per candidate, costing more than the replay it saves — otherwise
+    every query answers True (no filtering, search unchanged).
+    """
+
+    def __init__(
+        self,
+        run: SchedulerRun,
+        devices,
+        group_of: Dict[str, str],
+        assign: Dict[str, int],
+    ):
+        self.devices = devices
+        self.enabled = False
+        self.state = dict(assign)
+        try:
+            from ..analysis import IncrementalAnalyzer
+
+            order = run.graph.topo_order
+        except Exception:
+            return
+        # tasks per group in one fixed topo order, so every mirrored
+        # per-node list is a subsequence of assignment_order — the
+        # invariant the analyzer's fast path rests on
+        self.tasks_of: Dict[str, List[str]] = {}
+        per_node: Dict[str, List[str]] = {d.node_id: [] for d in devices}
+        placed_order: List[str] = []
+        for tid in order:
+            g = group_of.get(tid)
+            if g is None or g not in assign:
+                continue
+            self.tasks_of.setdefault(g, []).append(tid)
+            per_node[devices[assign[g]].node_id].append(tid)
+            placed_order.append(tid)
+        mirror = Schedule(
+            policy="refine-static",
+            per_node=per_node,
+            assignment_order=placed_order,
+            completed=set(placed_order),
+        )
+        try:
+            self._inc = IncrementalAnalyzer(run.graph, run.cluster, mirror)
+        except Exception:
+            return
+        self.base_errors = self._inc.error_count()
+        self.enabled = self._inc.exact_fast_path
+
+    def _apply(self, frm: Dict[str, int], to: Dict[str, int]) -> None:
+        for g, d in to.items():
+            if frm.get(g) == d:
+                continue
+            dst = self.devices[d].node_id
+            for tid in self.tasks_of.get(g, ()):
+                self._inc.move_task(tid, dst)
+
+    def ok(self, cand: Dict[str, int]) -> bool:
+        """True iff ``cand`` adds no ERROR over the seed baseline."""
+        if not self.enabled:
+            return True
+        self._apply(self.state, cand)
+        good = self._inc.error_count() <= self.base_errors
+        self._apply(cand, self.state)  # revert; subsequence re-insertion
+        return good                    # restores the exact prior lists
+
+    def sync(self, assign: Dict[str, int]) -> None:
+        """Advance the mirror to an accepted incumbent so later ``ok()``
+        queries diff against it (one or two group moves, not the whole
+        drift from the seed)."""
+        if not self.enabled:
+            return
+        self._apply(self.state, assign)
+        self.state = dict(assign)
 
 
 class RefinedPackScheduler(GroupPackScheduler):
@@ -81,6 +165,7 @@ class RefinedPackScheduler(GroupPackScheduler):
         group_of = {
             t.task_id: (t.group or t.task_id) for t in graph.tasks()
         }
+        flt = _StaticMoveFilter(run, devices, group_of, placed)
 
         def union_gb(names: Set[str]) -> float:
             return sum(graph.param_size_gb(p) for p in sorted(names))
@@ -145,11 +230,12 @@ class RefinedPackScheduler(GroupPackScheduler):
                         # move g -> d
                         cand = dict(cur)
                         cand[g] = d
-                        if fits(cand, d):
+                        if fits(cand, d) and flt.ok(cand):
                             m, nf = evaluate(cand)
                             evals += 1
                             if m < cur_m - self.tol:
                                 cur, cur_m, node_finish = cand, m, nf
+                                flt.sync(cand)
                                 improved = True
                                 break
                             if evals >= self.max_evals:
@@ -163,11 +249,16 @@ class RefinedPackScheduler(GroupPackScheduler):
                         )
                         cand = dict(cur)
                         cand[g], cand[g2] = d, b_idx
-                        if fits(cand, d) and fits(cand, b_idx):
+                        if (
+                            fits(cand, d)
+                            and fits(cand, b_idx)
+                            and flt.ok(cand)
+                        ):
                             m, nf = evaluate(cand)
                             evals += 1
                             if m < cur_m - self.tol:
                                 cur, cur_m, node_finish = cand, m, nf
+                                flt.sync(cand)
                                 improved = True
                                 break
                             if evals >= self.max_evals:
@@ -196,12 +287,14 @@ class RefinedPackScheduler(GroupPackScheduler):
                     moved[g] = d
                     if fits(moved, d):
                         cand = moved
-            if cand == best:
-                # every proposed move was infeasible; don't burn the whole
-                # budget re-evaluating the unchanged incumbent
+            if cand == best or not flt.ok(cand):
+                # every proposed move was infeasible (or the perturbed
+                # placement fails the static pre-filter); don't burn the
+                # whole budget re-evaluating or replaying it
                 stale += 1
                 continue
             stale = 0
+            flt.sync(cand)
             m, nf = evaluate(cand)
             evals += 1
             cur, cur_m, _ = climb(cand, m, nf)

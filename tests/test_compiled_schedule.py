@@ -288,13 +288,16 @@ def test_compiled_incompatible_flags(dag_setup):
     backend = DeviceBackend(cluster)
     schedule = get_scheduler("roundrobin").schedule(dag.graph, cluster)
     for bad in (
-        dict(segments=True), dict(profile=True), dict(stream_params=True),
+        dict(segments=True), dict(profile=True),
         dict(keep_outputs=True), dict(planned=True),
     ):
         with pytest.raises(ValueError):
             backend.execute(
                 dag.graph, schedule, params, ids, compiled=True, **bad
             )
+    # stream_params is no longer an unconditional refusal: the stream
+    # prover (analysis/stream_pass.py) decides per schedule — see
+    # test_typecheck.py for the accept/refuse integration pair.
 
 
 def test_donation_summary_passes_analysis(dag_setup):

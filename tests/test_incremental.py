@@ -1,0 +1,224 @@
+"""Incremental re-analysis engine (analysis/incremental): delta
+semantics, the exact-agreement `verify()` contract under 200 random
+moves on the medium DAG, the >=20x speedup acceptance gate, and the
+refine scheduler's static pre-filter wiring."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, DeviceState, Task, TaskGraph
+from distributed_llm_scheduler_tpu.analysis import (
+    IncrementalAnalyzer,
+    analyze,
+    pre_execution_gate,
+)
+from distributed_llm_scheduler_tpu.core.schedule import Schedule
+
+GB = 1 << 30
+
+
+def sched(per_node, order=None):
+    if order is None:
+        order = [t for tids in per_node.values() for t in tids]
+    return Schedule(
+        policy="manual",
+        per_node=per_node,
+        assignment_order=order,
+        completed=set(order),
+    )
+
+
+def chain_graph(sizes_gb=(0.1, 0.1, 0.1, 0.1)):
+    tasks, prev = [], []
+    for i, s in enumerate(sizes_gb):
+        tasks.append(Task(
+            f"t{i}", 0.05, 1.0, list(prev), {f"p{i}"},
+            param_bytes={f"p{i}": int(s * GB)},
+        ))
+        prev = [f"t{i}"]
+    return TaskGraph(tasks).freeze()
+
+
+def two_caps(cap0=4.0, cap1=4.0):
+    return Cluster([DeviceState("n0", cap0), DeviceState("n1", cap1)])
+
+
+# -- delta semantics ---------------------------------------------------------
+
+def test_move_produces_delta_and_undo_restores():
+    g = chain_graph((0.1, 0.8, 0.1, 0.1))
+    cluster = two_caps(0.5, 4.0)  # n0 tight: t1 alone overcommits it
+    inc = IncrementalAnalyzer(
+        g, cluster, sched({"n0": ["t0"], "n1": ["t1", "t2", "t3"]})
+    )
+    assert inc.exact_fast_path and inc.error_count() == 0
+    base = {d.code for d in inc.report.diagnostics}
+
+    d = inc.move_task("t1", "n0")  # 0.85 GB footprint on a 0.5 GB node
+    assert (d.src, d.dst) == ("n1", "n0")
+    assert not d.ok and any(x.code == "MEM003" for x in d.added)
+    assert inc.error_count() > 0
+    assert any(k.startswith("mem:") for k in d.recomputed)
+
+    u = inc.move_task("t1", d.src)  # exact undo
+    assert u.ok and inc.error_count() == 0
+    assert {x.code for x in inc.report.diagnostics} == base
+    inc.verify()
+
+
+def test_move_noop_and_bad_args():
+    g = chain_graph((0.1, 0.1))
+    inc = IncrementalAnalyzer(g, two_caps(), sched({"n0": ["t0", "t1"]}))
+    d = inc.move_task("t0", "n0")
+    assert d.added == [] and d.removed == [] and d.recomputed == ()
+    with pytest.raises(KeyError):
+        inc.move_task("t0", "bogus")
+    with pytest.raises(KeyError):
+        inc.move_task("ghost", "n1")
+
+
+def test_moves_never_mutate_caller_schedule():
+    g = chain_graph((0.1, 0.1, 0.1))
+    s = sched({"n0": ["t0", "t1", "t2"]})
+    snap = s.signature()
+    inc = IncrementalAnalyzer(g, two_caps(), s)
+    inc.move_task("t1", "n1")
+    assert s.signature() == snap
+    assert inc.placement["t1"] == "n1"
+    assert inc.report.schedule_signature != snap
+
+
+def test_dirty_baseline_degrades_but_stays_exact():
+    # SCH009 baseline (dependency-inverted order): fast path must be off,
+    # moves fall back to full recomputes, verify still agrees exactly
+    g = chain_graph((0.1, 0.1, 0.1))
+    inc = IncrementalAnalyzer(
+        g, two_caps(), sched({"n0": ["t1", "t0", "t2"]},
+                             order=["t1", "t0", "t2"])
+    )
+    assert not inc.exact_fast_path
+    d = inc.move_task("t2", "n1")
+    assert d.recomputed == ("all",)
+    inc.verify()
+
+
+def test_report_tracks_signature_for_gate_compat():
+    # the incremental report is NOT gate food (narrower suite) — but the
+    # full analyze() of the post-move schedule is; check the handoff path
+    g = chain_graph((0.1, 0.1, 0.1))
+    cluster = two_caps()
+    inc = IncrementalAnalyzer(g, cluster, sched({"n0": ["t0", "t1", "t2"]}))
+    inc.move_task("t2", "n1")
+    rep = analyze(g, cluster, inc.schedule)
+    gated = pre_execution_gate(
+        g, cluster, inc.schedule, backend="sim", precomputed=rep
+    )
+    assert gated is not None and gated.ok
+
+
+# -- the medium-DAG property + acceptance gates ------------------------------
+
+@pytest.fixture(scope="module")
+def medium():
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import (
+        GPT2Config,
+        build_gpt2_dag,
+    )
+    from distributed_llm_scheduler_tpu.sched.pack import GroupPackScheduler
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), n_layer=24)
+    dag = build_gpt2_dag(
+        cfg, batch=8, seq_len=8, microbatches=8, vocab_shards=8
+    )
+    cluster = Cluster.from_jax_devices(hbm_cap_gb=4.0)
+    schedule = GroupPackScheduler().schedule(dag.graph, cluster)
+    return dag, cluster, schedule
+
+
+def test_property_200_random_moves_match_fresh_analysis(medium):
+    dag, cluster, schedule = medium
+    inc = IncrementalAnalyzer(dag.graph, cluster, schedule)
+    assert inc.exact_fast_path
+    rng = random.Random(1234)
+    tids = sorted(inc.placement)
+    nodes = [d.node_id for d in cluster]
+    for i in range(200):
+        tid = rng.choice(tids)
+        dst = rng.choice([n for n in nodes if n != inc.placement[tid]])
+        inc.move_task(tid, dst)
+        # verify() re-runs the FULL suite fresh and raises on the first
+        # diagnostic-level divergence: the exactness contract, enforced
+        # after every single move
+        inc.verify()
+    assert inc.moves == 200
+
+
+def test_speedup_at_least_20x_vs_full_analyze(medium):
+    dag, cluster, schedule = medium
+    kw = dict(params=dag.param_specs, graph_input=dag.input_spec)
+
+    t0 = time.perf_counter()
+    analyze(dag.graph, cluster, schedule, **kw)
+    full_s = time.perf_counter() - t0
+
+    inc = IncrementalAnalyzer(dag.graph, cluster, schedule, **kw)
+    assert inc.exact_fast_path
+    rng = random.Random(7)
+    tids = sorted(inc.placement)
+    nodes = [d.node_id for d in cluster]
+    moves = [(rng.choice(tids), rng.choice(nodes)) for _ in range(100)]
+    t0 = time.perf_counter()
+    for tid, dst in moves:
+        inc.move_task(tid, dst)
+    per_move = (time.perf_counter() - t0) / len(moves)
+
+    inc.verify()  # speed without exactness proves nothing
+    assert full_s / per_move >= 20.0, (
+        f"move_task {per_move * 1e3:.2f} ms vs full analyze "
+        f"{full_s * 1e3:.0f} ms: {full_s / per_move:.1f}x"
+    )
+
+
+# -- refine wiring -----------------------------------------------------------
+
+def test_refine_static_filter_rejects_infeasible_move():
+    from distributed_llm_scheduler_tpu.sched.refine import _StaticMoveFilter
+    from distributed_llm_scheduler_tpu.sched.base import SchedulerRun
+
+    g = chain_graph((0.1, 1.5, 0.1, 0.1))  # t1 overcommits a 1.0 GB node
+    cluster = two_caps(1.0, 4.0)
+    run = SchedulerRun(graph=g, cluster=cluster)
+    group_of = {t.task_id: t.task_id for t in g.tasks()}
+    assign = {"t0": 1, "t1": 1, "t2": 1, "t3": 1}
+    flt = _StaticMoveFilter(run, cluster.devices, group_of, assign)
+    assert flt.enabled
+    # t1's own footprint exceeds device 0's capacity: MEM003, rejected
+    assert not flt.ok({**assign, "t1": 0})
+    # a small group fits: accepted, and state advances on sync
+    ok_assign = {**assign, "t0": 0}
+    assert flt.ok(ok_assign)
+    flt.sync(ok_assign)
+    assert flt.state == ok_assign
+    assert flt.ok({**ok_assign, "t1": 0}) is False  # still overcommits
+
+
+def test_refine_end_to_end_still_schedules():
+    from distributed_llm_scheduler_tpu.frontend.gpt2_dag import (
+        GPT2Config,
+        build_gpt2_dag,
+    )
+    from distributed_llm_scheduler_tpu.sched.refine import (
+        RefinedPackScheduler,
+    )
+
+    dag = build_gpt2_dag(GPT2Config.tiny(), batch=2, seq_len=8)
+    cluster = Cluster.uniform(4, 4.0)
+    s = RefinedPackScheduler(max_evals=60).schedule(dag.graph, cluster)
+    assert not s.failed
+    rep = analyze(dag.graph, cluster, s)
+    assert rep.exit_code == 0
