@@ -1216,6 +1216,130 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _slo_live_requests(args, flight):
+    """One small paged continuous-batching leg (gpt2 family, 2 slots)
+    with the flight recorder wired; returns ``(rc, dls.requests/1
+    snapshot)`` — rc 2 when the configuration cannot run."""
+    from .backends.device import DeviceBackend
+
+    cfg = _config_from(args)
+    if _weights_family(cfg.model) != "gpt2":
+        print("slo: live run needs a gpt2-family model (paged decode)",
+              file=sys.stderr)
+        return 2, None
+    import jax
+    import jax.numpy as jnp
+
+    from .core.cluster import Cluster
+    from .frontend.decode_dag import build_paged_decode_dag
+    from .models.kv_pages import PagePool
+
+    mcfg = cfg.model_config()
+    slots, ps, n_pages, ppseq = 2, 8, 32, 4
+    ddag = build_paged_decode_dag(
+        mcfg, slots=slots, page_size=ps, n_pages=n_pages,
+        pages_per_seq=ppseq,
+    )
+    params = ddag.init_params()
+    weights = {k: v for k, v in params.items()
+               if not (k.startswith("cache_") or k == "page_table")}
+    dcluster = Cluster.from_jax_devices(jax.devices()[:1])
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+    eng = DeviceBackend(dcluster).paged_decode_engine(
+        ddag.graph, cfg.build_scheduler().schedule(ddag.graph, dcluster),
+        mcfg, weights, pool, slots=slots, pages_per_seq=ppseq, seg_steps=4,
+        flight=flight,
+    )
+    n_req = getattr(args, "n_requests", 4) or 4
+    for i in range(n_req):
+        ids = jnp.asarray([[1 + (i % 3), 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        eng.submit(f"r{i}", ids, 6)
+    eng.run()
+    return 0, eng.reqlog.snapshot()
+
+
+def cmd_slo(args) -> int:
+    """SLO report + gate over a request log (``--requests``: a
+    ``dls.requests/1`` snapshot, a flight dump, or a decode-bench
+    artifact with a paged leg) or a fresh live paged-decode run.  Exit 0
+    when every window meets the policy, 1 on breach (the worst window
+    and metric are named on stderr), 2 on malformed/empty request logs
+    or an unrunnable configuration."""
+    from .obs import FlightRecorder, SLOPolicy, evaluate_slo
+    from .obs import reqlog as _reqlog
+
+    try:
+        policy = SLOPolicy(
+            ttft_s=args.ttft, tpot_s=args.tpot, e2e_s=args.e2e,
+            window_s=args.window, percentile=args.percentile,
+        )
+    except ValueError as e:
+        print(f"slo: {e} (pass --ttft/--tpot/--e2e)", file=sys.stderr)
+        return 2
+
+    flight = None
+    if args.requests:
+        try:
+            with open(args.requests) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"slo: unreadable request log {args.requests}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not isinstance(obj, dict):
+            print(f"slo: {args.requests} is not a JSON object",
+                  file=sys.stderr)
+            return 2
+        if obj.get("schema") == _reqlog.SCHEMA:
+            snap = obj
+        elif isinstance(obj.get("request_log"), dict):
+            snap = obj["request_log"]       # a flight-recorder dump
+        elif (isinstance(obj.get("paged"), dict)
+              and isinstance(obj["paged"].get("requests"), dict)):
+            snap = obj["paged"]["requests"]  # a decode-bench artifact
+        else:
+            print(f"slo: no dls.requests/1 block found in {args.requests}",
+                  file=sys.stderr)
+            return 2
+    else:
+        flight = FlightRecorder()
+        rc, snap = _slo_live_requests(args, flight)
+        if rc:
+            return rc
+
+    errs = _reqlog.validate_request_log(snap)
+    if errs:
+        for e in errs[:10]:
+            print(f"slo: {e}", file=sys.stderr)
+        return 2
+    if not snap.get("requests"):
+        print("slo: request log is empty", file=sys.stderr)
+        return 2
+
+    report = evaluate_slo(snap, policy)
+    out = {
+        "requests": _reqlog.summarize_request_log(snap),
+        "slo": report.summary(),
+    }
+    if report.exceeds() and flight is not None and args.flight_dir:
+        from .obs.export import validate_trace
+
+        rec = flight.maybe_dump(args.flight_dir, slo_report=report)
+        out["flight_dump"] = dict(
+            rec, trace_valid=validate_trace(rec["trace"]) == []
+        )
+    print(json.dumps(out, indent=1))
+    if report.exceeds():
+        b = report.worst_breach()
+        print(
+            f"slo: {b['metric']} {b['percentile']}={b['value']:.6g}s "
+            f"exceeds target {b['target']:.6g}s in window {b['window']} "
+            f"[{b['t_start']:.3f}s, {b['t_end']:.3f}s)", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_doctor(args) -> int:
     """Run doctor: measured critical-path attribution (+ cost-model
     drift when the run is live).  ``--trace`` diagnoses an exported
@@ -1223,6 +1347,10 @@ def cmd_doctor(args) -> int:
     execute of the model DAG is attributed directly.  Exit 2 when
     nothing is attributable, 1 when drift exceeds ``--drift-threshold``,
     0 otherwise.
+
+    ``--slo`` switches to the SLO doctor: one flight-recorded paged
+    decode leg, the sliding-window report for the ``--slo-*`` targets,
+    exit 1 on breach.
 
     ``--memory`` switches to the MEMORY doctor: one memprof-instrumented
     execute (the default planned path — no per-task profile fences
@@ -1235,6 +1363,8 @@ def cmd_doctor(args) -> int:
 
     if getattr(args, "memory", False):
         return _cmd_doctor_memory(args)
+    if getattr(args, "slo", False):
+        return _cmd_doctor_slo(args)
     if args.trace:
         try:
             att = attribute_trace(args.trace)
@@ -1339,6 +1469,43 @@ def _cmd_doctor_memory(args) -> int:
               f"{drift.worst_ratio():.2f}x exceeds the "
               f"--mem-drift-threshold {args.mem_drift_threshold:g}x gate",
               file=sys.stderr)
+        return 1
+    return 0
+
+
+def _cmd_doctor_slo(args) -> int:
+    """The SLO half of the doctor (``doctor --slo``)."""
+    from .obs import FlightRecorder, SLOPolicy, evaluate_slo
+    from .obs.reqlog import summarize_request_log
+
+    try:
+        policy = SLOPolicy(
+            ttft_s=args.slo_ttft, tpot_s=args.slo_tpot,
+            e2e_s=args.slo_e2e, window_s=args.slo_window,
+        )
+    except ValueError as e:
+        print(f"doctor --slo: {e} (pass --slo-ttft/--slo-tpot/--slo-e2e)",
+              file=sys.stderr)
+        return 2
+    flight = FlightRecorder()
+    rc, snap = _slo_live_requests(args, flight)
+    if rc:
+        return rc
+    if not snap.get("requests"):
+        print("doctor --slo: run recorded no requests", file=sys.stderr)
+        return 2
+    report = evaluate_slo(snap, policy)
+    print(json.dumps(
+        {"requests": summarize_request_log(snap), "slo": report.summary()},
+        indent=1,
+    ))
+    if report.exceeds():
+        b = report.worst_breach()
+        print(
+            f"doctor: {b['metric']} {b['percentile']}={b['value']:.6g}s "
+            f"exceeds the --slo target {b['target']:.6g}s in window "
+            f"{b['window']}", file=sys.stderr,
+        )
         return 1
     return 0
 
@@ -1639,6 +1806,37 @@ def main(argv=None) -> int:
     pd.set_defaults(fn=cmd_metrics_diff)
 
     p = sub.add_parser(
+        "slo",
+        help="sliding-window SLO report + gate (exit 1 on breach) over "
+             "a request log or a fresh flight-recorded paged-decode run",
+    )
+    _add_common(p)
+    p.add_argument("--requests", default=None, metavar="PATH",
+                   help="offline mode: evaluate this dls.requests/1 "
+                        "snapshot (also accepts a flight-recorder dump "
+                        "or a decode-bench artifact with a paged leg) "
+                        "instead of running live")
+    p.add_argument("--ttft", type=float, default=None, metavar="SECONDS",
+                   help="per-window TTFT target at --percentile")
+    p.add_argument("--tpot", type=float, default=None, metavar="SECONDS",
+                   help="per-window TPOT (inter-token) target")
+    p.add_argument("--e2e", type=float, default=None, metavar="SECONDS",
+                   help="per-window end-to-end latency target")
+    p.add_argument("--window", type=float, default=1.0, metavar="SECONDS",
+                   help="sliding wall-clock window size (default 1.0)")
+    p.add_argument("--percentile", default="p95",
+                   choices=("p50", "p95", "p99"),
+                   help="which per-window quantile gates (default p95)")
+    p.add_argument("--n-requests", type=int, default=4, dest="n_requests",
+                   help="live mode: requests to submit over the 2-slot "
+                        "engine (default 4)")
+    p.add_argument("--flight-dir", default=None, dest="flight_dir",
+                   metavar="DIR",
+                   help="live mode: on breach, dump the flight-recorder "
+                        "rings (Perfetto trace + request log) here")
+    p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
         "doctor",
         help="explain a run: measured critical-path attribution "
              "(compute/transfer/dispatch/idle) + cost-model drift",
@@ -1667,6 +1865,19 @@ def main(argv=None) -> int:
                         "two-sided measured-vs-predicted peak ratio "
                         "max(r, 1/r) exceeds RATIO (default: report "
                         "only, never gate)")
+    p.add_argument("--slo", action="store_true",
+                   help="SLO doctor: one flight-recorded paged decode "
+                        "leg, sliding-window report for the --slo-* "
+                        "targets, exit 1 on breach")
+    p.add_argument("--slo-ttft", type=float, default=None, dest="slo_ttft",
+                   metavar="SECONDS", help="with --slo: TTFT target")
+    p.add_argument("--slo-tpot", type=float, default=None, dest="slo_tpot",
+                   metavar="SECONDS", help="with --slo: TPOT target")
+    p.add_argument("--slo-e2e", type=float, default=None, dest="slo_e2e",
+                   metavar="SECONDS", help="with --slo: e2e target")
+    p.add_argument("--slo-window", type=float, default=1.0,
+                   dest="slo_window", metavar="SECONDS",
+                   help="with --slo: window size (default 1.0)")
     p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(

@@ -335,12 +335,20 @@ class PagedDecodeEngine:
         metrics: Any = None,
         clock: Any = None,
         memprof: Any = None,
+        flight: Any = None,
     ):
         import numpy as np
 
         from ..frontend.decode_dag import cache_dims as _cd
         from ..models.kv_pages import TRASH_PAGE, init_paged_kv
-        from ..obs import MetricsRegistry, ambient_metrics, ambient_tracer
+        from ..obs import (
+            MetricsRegistry,
+            RequestLog,
+            TeeTracer,
+            ambient_flight,
+            ambient_metrics,
+            ambient_tracer,
+        )
 
         self.config = config
         self.weights = weights
@@ -394,6 +402,21 @@ class PagedDecodeEngine:
         self._clock = clock if clock is not None else time.perf_counter
         self._submit_t: Dict[Any, float] = {}     # rid -> submit() time
         self._first_tok_t: Dict[Any, float] = {}  # rid -> first-token time
+        # flight recorder (explicit, or ambient under DLS_FLIGHT): its
+        # ring tracer joins the span stream — alone when no tracer was
+        # wired, teed alongside an explicit/ambient one otherwise
+        self.flight = flight if flight is not None else ambient_flight()
+        if self.flight is not None:
+            if self.tracer is None:
+                self.tracer = self.flight.tracer
+            else:
+                self.tracer = TeeTracer(self.tracer, self.flight.tracer)
+        # request lifecycle log: always on, like the registry — recording
+        # is a dict write per lifecycle seam, host side, outside the
+        # scanned program.  Timestamps are the SAME clock reads the
+        # ttft/tpot histograms observe (bitwise-match contract).
+        self.reqlog = RequestLog(clock=self._clock)
+        self._reqlogs = self._req_sinks()
         # memory doctor: per-request KV page occupancy folds onto the
         # profiler's timeline as kv_pages-bucket allocations (born at
         # admission, freed at retirement) sized by the physical page —
@@ -407,6 +430,12 @@ class PagedDecodeEngine:
         # the pools are one placed slab: attribute kv pages to the node
         # the schedule put the decode step on
         self._mem_node = next(iter(schedule.placement.values()), "node0")
+
+    def _req_sinks(self):
+        """The engine's full log plus (when wired) the flight ring."""
+        if self.flight is not None:
+            return (self.reqlog, self.flight.reqlog)
+        return (self.reqlog,)
 
     def reset(self) -> None:
         """Fresh pool/table/queue state, compiled programs kept.
@@ -444,8 +473,24 @@ class PagedDecodeEngine:
         self.segments_run = 0
         self._submit_t = {}
         self._first_tok_t = {}
+        # fresh request log per run (benches reset between reps); the
+        # flight ring deliberately survives — it is the always-on
+        # last-N record across runs
+        from ..obs import RequestLog
+
+        self.reqlog = RequestLog(clock=self._clock)
+        self._reqlogs = self._req_sinks()
 
     # -- request intake ----------------------------------------------------
+    def _emit_queue_depth(self) -> None:
+        """The ONE place queue depth reaches both surfaces: the metrics
+        gauge and (when tracing) the tracer counter track sample the
+        same value at the same event, so they cannot disagree."""
+        depth = len(self._queue)
+        self.metrics.gauge("decode.queue_depth").set(depth)
+        if self.tracer is not None:
+            self.tracer.counter("decode.queue_depth", depth)
+
     def submit(self, rid: Any, prompt_ids: Any, max_new_tokens: int) -> None:
         """Queue a request; admitted into a free slot (and its pages
         allocated) at the next segment boundary."""
@@ -462,10 +507,12 @@ class PagedDecodeEngine:
                 f"{self.page_size})"
             )
         self._queue.append((rid, prompt_ids, max_new_tokens))
-        self._submit_t[rid] = self._clock()
+        t_sub = self._clock()
+        self._submit_t[rid] = t_sub
+        for rl in self._reqlogs:
+            rl.submit(rid, int(prompt_ids.shape[1]), max_new_tokens, t_sub)
         self.metrics.counter("decode.requests_submitted").inc()
-        if self.tracer is not None:
-            self.tracer.counter("decode.queue_depth", len(self._queue))
+        self._emit_queue_depth()
 
     # -- prefill + page scatter (ONE call per admission ROUND; one
     # compiled class per (prompt length, batch size)) ----------------------
@@ -567,7 +614,9 @@ class PagedDecodeEngine:
                         self._mem_node, f"kv:{rid}",
                         need * self._page_bytes, "kv_pages",
                     )
-            t_pf0 = self._clock() if self.tracer is not None else 0.0
+            # unconditional read: t_pf0 is each batched request's
+            # admission timestamp in the lifecycle log
+            t_pf0 = self._clock()
             first = self._prefill_scatter(
                 jnp.concatenate([ids for _, ids, _, _ in batch], axis=0),
                 pt_rows,
@@ -592,6 +641,11 @@ class PagedDecodeEngine:
                 self._slot_pages[s] = page_lists[j]
                 self._tokens[rid] = [int(first[j])]
                 self._first_tok_t[rid] = t_adm
+                # t_pf0/t_adm are the same floats the histograms see:
+                # record-derived TTFT == histogram sample, bitwise
+                for rl in self._reqlogs:
+                    rl.admit(rid, t_pf0)
+                    rl.first_token(rid, t_adm)
                 sub_t = self._submit_t.pop(rid, None)
                 if sub_t is not None:
                     ttft_h.observe(t_adm - sub_t)
@@ -601,16 +655,15 @@ class PagedDecodeEngine:
             self.metrics.counter("decode.admission_waves").inc()
             if ev_wave is not None:
                 self.tracer.end(ev_wave)
-                self.tracer.counter("decode.queue_depth", len(self._queue))
+            if self.tracer is not None:
                 self.tracer.counter(
                     "decode.page_pool_occupancy_pages", self.pool.used_pages
                 )
+            self._emit_queue_depth()
         if admitted:
-            occ = self.metrics.gauge(
+            self.metrics.gauge(
                 "decode.page_pool_occupancy_pages", unit="pages"
-            )
-            occ.set(self.pool.used_pages)
-            self.metrics.gauge("decode.queue_depth").set(len(self._queue))
+            ).set(self.pool.used_pages)
         return admitted
 
     def _retire(self, s: int) -> None:
@@ -629,14 +682,19 @@ class PagedDecodeEngine:
         # first token's, over n-1 gaps; single-token requests have none
         n = len(self.results[rid])
         t_first = self._first_tok_t.pop(rid, None)
+        # ONE clock read feeds the histogram, the lifecycle log, and the
+        # trace marker — record-derived TPOT == histogram sample, bitwise
+        t_ret = self._clock()
         if t_first is not None and n > 1:
             self.metrics.histogram("decode.tpot_s", unit="s").observe(
-                (self._clock() - t_first) / (n - 1)
+                (t_ret - t_first) / (n - 1)
             )
+        for rl in self._reqlogs:
+            rl.retire(rid, t_ret)
         if self.tracer is not None:
             self.tracer.instant(
-                "retire", track="decode", cat="decode", rid=str(rid),
-                tokens=n,
+                "retire", track="decode", cat="decode", t=t_ret,
+                rid=str(rid), tokens=n,
             )
 
     # -- the serving loop --------------------------------------------------
@@ -647,15 +705,18 @@ class PagedDecodeEngine:
         owed = self.remaining.copy()
         if not owed.any():
             return 0
-        t_sg0 = self._clock() if self.tracer is not None else 0.0
+        t_sg0 = self._clock()
         toks, self.pools = self._seg(
             self.pools, self.page_table, self.lengths,
             self.cur_tok, self.remaining,
         )
         toks = self._np.asarray(toks)  # the one readback per segment
+        # the fold timestamp: every token this segment delivered became
+        # host-visible at this readback (lifecycle-log delivery events)
+        t_sg1 = self._clock()
         if self.tracer is not None:
             self.tracer.complete(
-                "segment", t_sg0, self._clock(), track="decode",
+                "segment", t_sg0, t_sg1, track="decode",
                 cat="decode", steps=self.seg_steps,
                 active=int((owed > 0).sum()),
             )
@@ -674,6 +735,8 @@ class PagedDecodeEngine:
                 self._tokens[rid].extend(int(t) for t in toks[s, :n])
                 self.cur_tok[s, 0] = toks[s, n - 1]
                 delivered += n
+                for rl in self._reqlogs:
+                    rl.deliver(rid, t_sg1, n)
             if owed[s] <= self.seg_steps:
                 self._retire(s)
         self.segments_run += 1
@@ -682,12 +745,11 @@ class PagedDecodeEngine:
         self.metrics.gauge(
             "decode.page_pool_occupancy_pages", unit="pages"
         ).set(self.pool.used_pages)
-        self.metrics.gauge("decode.queue_depth").set(len(self._queue))
         if self.tracer is not None:
             self.tracer.counter(
                 "decode.page_pool_occupancy_pages", self.pool.used_pages
             )
-            self.tracer.counter("decode.queue_depth", len(self._queue))
+        self._emit_queue_depth()
         return delivered
 
     def run(self) -> Dict[Any, Any]:

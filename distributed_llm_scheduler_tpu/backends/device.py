@@ -1309,6 +1309,7 @@ class DeviceBackend:
         metrics: Any = None,
         clock: Any = None,
         memprof: Any = None,
+        flight: Any = None,
     ):
         """Continuous-batching paged decode engine over a SCHEDULED paged
         decode-step DAG (``frontend.build_paged_decode_dag``).
@@ -1333,6 +1334,7 @@ class DeviceBackend:
             graph, schedule, config, weights, pool,
             slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
             tracer=trace, metrics=metrics, clock=clock, memprof=memprof,
+            flight=flight,
         )
 
     def execute(

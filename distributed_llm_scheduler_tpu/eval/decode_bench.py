@@ -1091,7 +1091,21 @@ def measure_paged_decode(
         # the engine's own registry (TTFT/TPOT histograms, occupancy
         # gauges, request/token counters) — always present, obs
         "metrics": eng.metrics.snapshot(),
+        # the final timed rep's per-request lifecycle log (reset()
+        # starts a fresh log, so this is exactly one drained run) plus
+        # a report-only sliding-window SLO block: generous post-warmup
+        # targets so the artifact documents windowed percentiles and
+        # goodput without turning host jitter into a bench failure
+        "requests": eng.reqlog.snapshot(),
+        "slo": _evaluate_bench_slo(eng.reqlog),
     }
+
+
+def _evaluate_bench_slo(reqlog) -> Dict[str, Any]:
+    from ..obs.slo import SLOPolicy, evaluate_slo
+
+    policy = SLOPolicy(ttft_s=10.0, tpot_s=1.0, e2e_s=60.0, window_s=1.0)
+    return evaluate_slo(reqlog, policy).summary()
 
 
 def _round4(d):

@@ -15,7 +15,13 @@ subsystems (planned dispatch, segment fusion, paged decode):
 * :mod:`.memprof` — measured per-device HBM timelines with watermark
   attribution (the memory half of the doctor);
 * :mod:`.memdrift` — measured-vs-predicted memory peaks, per device and
-  per task, with the near-OOM headroom warnings.
+  per task, with the near-OOM headroom warnings;
+* :mod:`.reqlog` — per-request lifecycle records (queue-wait, TTFT,
+  token-delivery series, e2e) with the ``dls.requests/1`` schema;
+* :mod:`.slo` — sliding-window SLO accounting (windowed p50/p95/p99,
+  goodput vs raw throughput, breach gate) over the request log;
+* :mod:`.flight` — always-on bounded ring-buffer flight recorder that
+  dumps trace + request log on SLO breach / near-OOM / straggler.
 
 Everything is opt-in.  Two ways to turn it on:
 
@@ -41,15 +47,24 @@ from typing import Optional
 
 from .attribution import Attribution, attribute_run, attribute_trace
 from .drift import DriftReport, compute_drift
+from .flight import FlightRecorder, RingTracer, TeeTracer
 from .memdrift import MemDriftReport, compute_mem_drift
 from .memprof import MemoryProfiler
 from .metrics import MetricsRegistry
+from .reqlog import (
+    RequestLog,
+    RequestRecord,
+    summarize_request_log,
+    validate_request_log,
+)
+from .slo import SLOPolicy, SLOReport, evaluate_slo
 from .trace import HOST_TRACK, Tracer
 
 _TRUTHY = ("1", "true", "yes", "on")
 
 _ambient_tracer: Optional[Tracer] = None
 _ambient_metrics: Optional[MetricsRegistry] = None
+_ambient_flight: Optional[FlightRecorder] = None
 
 
 def trace_enabled() -> bool:
@@ -79,27 +94,58 @@ def ambient_metrics() -> Optional[MetricsRegistry]:
     return _ambient_metrics
 
 
+def flight_enabled() -> bool:
+    """True when ``DLS_FLIGHT`` requests the ambient flight recorder."""
+    return os.environ.get("DLS_FLIGHT", "").strip().lower() in _TRUTHY
+
+
+def ambient_flight() -> Optional[FlightRecorder]:
+    """The process-wide flight recorder when ``DLS_FLIGHT`` is set, else
+    None.  Same discipline as :func:`ambient_tracer`: with the env var
+    unset and no explicit recorder passed, engine hot paths see None and
+    do zero work — there is no no-op recorder object."""
+    global _ambient_flight
+    if not flight_enabled():
+        return None
+    if _ambient_flight is None:
+        _ambient_flight = FlightRecorder()
+    return _ambient_flight
+
+
 def reset_ambient() -> None:
-    """Drop the ambient tracer/registry (tests; fresh CLI legs)."""
-    global _ambient_tracer, _ambient_metrics
+    """Drop the ambient tracer/registry/flight (tests; fresh CLI legs)."""
+    global _ambient_tracer, _ambient_metrics, _ambient_flight
     _ambient_tracer = None
     _ambient_metrics = None
+    _ambient_flight = None
 
 
 __all__ = [
     "Attribution",
     "DriftReport",
+    "FlightRecorder",
     "HOST_TRACK",
     "MemDriftReport",
     "MemoryProfiler",
     "MetricsRegistry",
+    "RequestLog",
+    "RequestRecord",
+    "RingTracer",
+    "SLOPolicy",
+    "SLOReport",
+    "TeeTracer",
     "Tracer",
+    "ambient_flight",
     "ambient_metrics",
     "ambient_tracer",
     "attribute_run",
     "attribute_trace",
     "compute_drift",
     "compute_mem_drift",
+    "evaluate_slo",
+    "flight_enabled",
     "reset_ambient",
+    "summarize_request_log",
     "trace_enabled",
+    "validate_request_log",
 ]

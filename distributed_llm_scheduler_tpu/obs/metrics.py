@@ -14,7 +14,8 @@ and ``tests/test_obs.py`` guard it):
  "counters":   {"<name>": {"value": 0, "unit": null}},
  "gauges":     {"<name>": {"value": 0, "max": 0, "unit": null}},
  "histograms": {"<name>": {"count": 0, "sum": 0, "min": 0, "max": 0,
-                           "mean": 0, "p50": 0, "p95": 0, "unit": null}}}
+                           "mean": 0, "p50": 0, "p95": 0, "p99": 0,
+                           "unit": null}}}
 ```
 
 Metric names are dotted lowercase (``decode.ttft_s``); the ``_s`` /
@@ -29,13 +30,17 @@ the dispatch hot loop records only when observability is on.
 
 from __future__ import annotations
 
+import random
+import zlib
 from typing import Any, Dict, List, Optional
 
 SCHEMA = "dls.metrics/1"
 
 # histograms keep at most this many raw samples for the percentile
 # estimate; count/sum/min/max stay exact beyond it (serving-length runs
-# must not grow memory linearly in tokens)
+# must not grow memory linearly in tokens).  Beyond the cap the samples
+# are a uniform reservoir (Algorithm R), NOT the first N observed —
+# keep-first would freeze p50/p95/p99 on warmup forever.
 _HIST_CAP = 4096
 
 
@@ -69,18 +74,28 @@ class Gauge:
 
 
 class Histogram:
-    """Distribution sketch (latencies): exact count/sum/min/max, p50/p95
-    from the first :data:`_HIST_CAP` raw samples."""
+    """Distribution sketch (latencies): exact count/sum/min/max,
+    p50/p95/p99 from a :data:`_HIST_CAP`-slot uniform reservoir.
 
-    __slots__ = ("count", "sum", "min", "max", "unit", "_samples")
+    Reservoir sampling (Algorithm R) with a per-histogram seeded PRNG:
+    every observation — not just the first 4096 — has equal probability
+    of being in the sample, so quantiles track distribution shifts on
+    serving-length runs.  The seed is deterministic (the registry
+    derives it from the metric name), no global random state is
+    touched, and two runs observing the same sequence keep bitwise-
+    identical reservoirs.
+    """
 
-    def __init__(self, unit: Optional[str] = None):
+    __slots__ = ("count", "sum", "min", "max", "unit", "_samples", "_rng")
+
+    def __init__(self, unit: Optional[str] = None, seed: int = 0):
         self.count = 0
         self.sum: float = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.unit = unit
         self._samples: List[float] = []
+        self._rng = random.Random(seed)
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -92,6 +107,12 @@ class Histogram:
             self.max = v
         if len(self._samples) < _HIST_CAP:
             self._samples.append(v)
+        else:
+            # Algorithm R: keep the new sample with prob cap/count by
+            # overwriting a uniformly random reservoir slot
+            j = self._rng.randrange(self.count)
+            if j < _HIST_CAP:
+                self._samples[j] = v
 
     def _quantile(self, q: float) -> Optional[float]:
         if not self._samples:
@@ -124,7 +145,11 @@ class MetricsRegistry:
     def histogram(self, name: str, unit: Optional[str] = None) -> Histogram:
         h = self._hists.get(name)
         if h is None:
-            h = self._hists[name] = Histogram(unit)
+            # name-derived seed: deterministic across runs, distinct
+            # per histogram, no global random state
+            h = self._hists[name] = Histogram(
+                unit, seed=zlib.crc32(name.encode("utf-8"))
+            )
         return h
 
     def snapshot(self) -> Dict[str, Any]:
@@ -148,6 +173,7 @@ class MetricsRegistry:
                     "mean": (h.sum / h.count) if h.count else None,
                     "p50": h._quantile(0.50),
                     "p95": h._quantile(0.95),
+                    "p99": h._quantile(0.99),
                     "unit": h.unit,
                 }
                 for n, h in sorted(self._hists.items())
@@ -168,7 +194,7 @@ def validate_snapshot(snap: Any) -> List[str]:
         ("counters", ("value", "unit")),
         ("gauges", ("value", "max", "unit")),
         ("histograms", ("count", "sum", "min", "max", "mean", "p50",
-                        "p95", "unit")),
+                        "p95", "p99", "unit")),
     ):
         block = snap.get(family)
         if not isinstance(block, dict):
@@ -193,7 +219,8 @@ def _num_delta(a: Any, b: Any) -> Optional[float]:
 def diff_snapshots(a: Any, b: Any) -> Dict[str, Any]:
     """Structured diff of two ``dls.metrics/1`` snapshots (the ``metrics
     diff`` CLI): counter/gauge value deltas, histogram count and
-    p50/p95 quantile shifts, plus the names present on only one side.
+    p50/p95/p99 quantile shifts, plus the names present on only one
+    side.
     Both inputs must validate — raises ``ValueError`` listing the first
     problems otherwise (schema mismatch included)."""
     for tag, snap in (("a", a), ("b", b)):
@@ -207,7 +234,7 @@ def diff_snapshots(a: Any, b: Any) -> Dict[str, Any]:
     for family, keys in (
         ("counters", ("value",)),
         ("gauges", ("value", "max")),
-        ("histograms", ("count", "sum", "mean", "p50", "p95")),
+        ("histograms", ("count", "sum", "mean", "p50", "p95", "p99")),
     ):
         ba, bb = a[family], b[family]
         rows: Dict[str, Any] = {}

@@ -108,6 +108,20 @@ def test_decode_artifact_schema():
             assert validate_snapshot(paged["metrics"]) == [], path
             counters = paged["metrics"]["counters"]
             assert "decode.requests_completed" in counters, path
+        if "requests" in paged:  # request lifecycle log added r10
+            from distributed_llm_scheduler_tpu.obs.reqlog import (
+                validate_request_log,
+            )
+
+            assert validate_request_log(paged["requests"]) == [], path
+            rows = paged["requests"]["requests"]
+            assert len(rows) == paged["n_requests"], path
+            assert all(r["state"] == "retired" for r in rows), path
+            slo = paged.get("slo")
+            assert slo and slo.get("schema") == "dls.slo/1", path
+            for k in ("windows", "breaches", "goodput_frac",
+                      "tokens_total", "tokens_good"):
+                assert k in slo, (path, k)
 
 
 def test_artifact_obs_metrics_blocks_validate():

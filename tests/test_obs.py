@@ -26,7 +26,9 @@ from distributed_llm_scheduler_tpu.obs.export import (
     validate_trace,
 )
 from distributed_llm_scheduler_tpu.obs.metrics import (
+    _HIST_CAP,
     MetricsRegistry,
+    diff_snapshots,
     validate_snapshot,
 )
 from distributed_llm_scheduler_tpu.obs.trace import HOST_TRACK, Tracer
@@ -158,6 +160,72 @@ def test_validate_snapshot_rejects_malformed():
     }
     errs = validate_snapshot(bad)
     assert errs and any("c" in e for e in errs)
+    # p99 is contractual: a histogram row without it is malformed
+    no_p99 = {
+        "schema": "dls.metrics/1",
+        "counters": {},
+        "gauges": {},
+        "histograms": {"h": {
+            "count": 1, "sum": 1.0, "min": 1.0, "max": 1.0,
+            "mean": 1.0, "p50": 1.0, "p95": 1.0, "unit": None,
+        }},
+    }
+    assert any("p99" in e for e in validate_snapshot(no_p99))
+
+
+def test_histogram_reservoir_keeps_sampling_past_cap():
+    """The old keep-first reservoir froze percentiles after _HIST_CAP
+    observations; Algorithm R must let a regime change that happens
+    after the cap move the quantiles."""
+    reg = MetricsRegistry()
+    h = reg.histogram("decode.tpot_s")
+    for _ in range(_HIST_CAP):
+        h.observe(1.0)
+    snap0 = reg.snapshot()["histograms"]["decode.tpot_s"]
+    assert snap0["p50"] == 1.0 and snap0["p99"] == 1.0
+    # regime change entirely past the cap: 20x the reservoir size
+    for _ in range(20 * _HIST_CAP):
+        h.observe(100.0)
+    snap1 = reg.snapshot()["histograms"]["decode.tpot_s"]
+    assert snap1["count"] == 21 * _HIST_CAP  # exact stats never sampled
+    assert snap1["min"] == 1.0 and snap1["max"] == 100.0
+    assert snap1["p50"] == 100.0  # keep-first would still say 1.0
+    assert snap1["p99"] == 100.0
+    assert len(h._samples) == _HIST_CAP  # bounded memory
+
+
+def test_histogram_reservoir_is_deterministic_per_name():
+    """Seeding from the metric name (no global random state) makes two
+    registries fed the same stream agree bitwise."""
+    rega, regb = MetricsRegistry(), MetricsRegistry()
+    ha = rega.histogram("decode.ttft_s")
+    hb = regb.histogram("decode.ttft_s")
+    for i in range(3 * _HIST_CAP):
+        v = float(i % 97)
+        ha.observe(v)
+        hb.observe(v)
+    assert ha._samples == hb._samples
+    # a different name seeds a different reservoir
+    hc = MetricsRegistry().histogram("decode.tpot_s")
+    for i in range(3 * _HIST_CAP):
+        hc.observe(float(i % 97))
+    assert hc._samples != ha._samples
+
+
+def test_diff_snapshots_tracks_p99():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    a = reg.snapshot()
+    for v in (50.0, 60.0, 70.0, 80.0):
+        h.observe(v)
+    b = reg.snapshot()
+    d = diff_snapshots(a, b)
+    row = d["histograms"]["lat"]
+    assert row["p99_a"] == a["histograms"]["lat"]["p99"]
+    assert row["p99_b"] == b["histograms"]["lat"]["p99"]
+    assert row["p99_delta"] == row["p99_b"] - row["p99_a"]
 
 
 # ---------------------------------------------------------------------------

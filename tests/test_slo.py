@@ -1,0 +1,443 @@
+"""Request-lifecycle log, sliding-window SLO accounting, and the flight
+recorder: window-edge golden math on scripted clocks, the
+record-vs-histogram bitwise contract on the paged decode engine, ring
+eviction bounds, and the ``slo`` CLI's exit-code semantics."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+from distributed_llm_scheduler_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    RequestLog,
+    RingTracer,
+    SLOPolicy,
+    TeeTracer,
+    Tracer,
+    ambient_flight,
+    evaluate_slo,
+    flight_enabled,
+    reset_ambient,
+    summarize_request_log,
+    validate_request_log,
+)
+from distributed_llm_scheduler_tpu.obs.export import validate_trace
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _log_one(rid, t_submit, t_admit, t_first, deliveries, t_retire,
+             log=None, prompt_len=8, max_new=None):
+    """Script one request's full lifecycle into ``log``."""
+    if log is None:
+        log = RequestLog(clock=lambda: 0.0)
+    n_total = 1 + sum(n for _, n in deliveries)
+    log.submit(rid, prompt_len, max_new or n_total, t_submit)
+    log.admit(rid, t_admit)
+    log.first_token(rid, t_first)
+    for t, n in deliveries:
+        log.deliver(rid, t, n)
+    log.retire(rid, t_retire)
+    return log
+
+
+# ---------------------------------------------------------------------------
+# Request log basics
+
+
+def test_request_log_schema_and_derived_latencies():
+    log = _log_one("r0", 0.0, 0.2, 0.5, [(0.9, 2), (1.4, 2)], 1.5)
+    snap = log.snapshot()
+    assert validate_request_log(snap) == []
+    r = snap["requests"][0]
+    assert r["state"] == "retired"
+    assert r["queue_wait_s"] == 0.2
+    assert r["ttft_s"] == 0.5
+    assert r["n_tokens"] == 5
+    assert r["tpot_s"] == (1.5 - 0.5) / 4
+    assert r["e2e_s"] == 1.5
+    summ = summarize_request_log(snap)
+    assert summ["n_requests"] == summ["n_retired"] == 1
+    assert summ["tokens_delivered"] == 5
+    assert summ["ttft_s"]["p50"] == 0.5
+
+
+def test_request_log_validation_catches_malformed_rows():
+    log = _log_one("r0", 0.0, 0.1, 0.2, [(0.3, 2)], 0.4)
+    snap = log.snapshot()
+    snap["requests"][0]["n_tokens"] = 99  # contradict deliveries
+    assert any("sum of deliveries" in e for e in validate_request_log(snap))
+    assert validate_request_log({"schema": "nope"}) != []
+    assert validate_request_log([1, 2]) != []
+
+
+def test_request_log_capacity_evicts_oldest_retired_first():
+    log = RequestLog(clock=lambda: 0.0, capacity=2)
+    for i in range(5):
+        t = float(i)
+        _log_one(f"r{i}", t, t, t, [(t, 1)], t, log=log)
+    assert len(log) == 2
+    assert log.evicted == 3
+    # oldest evicted first: only the two newest remain, in order
+    assert [r.rid for r in log.records()] == ["r3", "r4"]
+    # in-flight records are never evicted
+    log2 = RequestLog(clock=lambda: 0.0, capacity=1)
+    log2.submit("a", 4, 2, 0.0)
+    log2.submit("b", 4, 2, 0.1)  # neither retired -> both kept
+    assert len(log2) == 2
+
+
+# ---------------------------------------------------------------------------
+# SLO window-edge golden math (scripted clocks)
+
+
+def test_slo_request_straddling_two_windows():
+    """TTFT evidence lands in the first-token window; TPOT/e2e in the
+    retire window; tokens in their delivery windows — one request can
+    contribute to two windows."""
+    log = _log_one("r0", 0.0, 0.2, 0.5, [(0.9, 2), (1.4, 2)], 1.5)
+    rep = evaluate_slo(log, SLOPolicy(ttft_s=1.0, tpot_s=1.0, window_s=1.0))
+    assert len(rep.windows) == 2
+    w0, w1 = rep.windows
+    # TTFT sample (0.5) in window 0 only
+    assert w0["ttft_s"]["n"] == 1 and w0["ttft_s"]["p95"] == 0.5
+    assert w1["ttft_s"]["n"] == 0 and w1["ttft_s"]["p95"] is None
+    # TPOT/e2e anchored at retire t=1.5 -> window 1
+    assert w0["tpot_s"]["n"] == 0
+    assert w1["tpot_s"]["n"] == 1 and w1["tpot_s"]["p95"] == 0.25
+    # tokens split: first token + 2 at t=0.9 in w0; 2 at t=1.4 in w1
+    assert (w0["tokens"], w1["tokens"]) == (3, 2)
+    assert not rep.exceeds()
+    assert rep.goodput_frac == 1.0
+    assert (w0["tokens_good"], w1["tokens_good"]) == (3, 2)
+
+
+def test_slo_goodput_with_mid_run_breach():
+    log = RequestLog(clock=lambda: 0.0)
+    # fast request: tpot (0.5-0.1)/4 = 0.1 -> meets the 0.5 target
+    _log_one("fast", 0.0, 0.05, 0.1, [(0.4, 4)], 0.5, log=log)
+    # slow request: tpot (2.4-0.2)/4 = 0.55 -> breaches, retire in w2
+    _log_one("slow", 0.0, 0.1, 0.2, [(2.3, 4)], 2.4, log=log)
+    rep = evaluate_slo(log, SLOPolicy(tpot_s=0.5, window_s=1.0))
+    assert rep.exceeds()
+    assert len(rep.breaches) == 1
+    b = rep.breaches[0]
+    assert b["metric"] == "tpot_s" and b["window"] == 2
+    assert b["value"] == pytest.approx(0.55) and b["target"] == 0.5
+    assert rep.worst_breach() is b
+    # goodput: the breacher's 5 tokens don't count
+    assert rep.tokens_total == 10 and rep.tokens_good == 5
+    assert rep.goodput_frac == 0.5
+    # the middle window saw no evidence at all
+    w1 = rep.windows[1]
+    assert w1["tokens"] == 0 and w1["tpot_s"]["n"] == 0
+
+
+def test_slo_empty_windows_and_empty_log():
+    log = RequestLog(clock=lambda: 0.0)
+    _log_one("a", 0.0, 0.1, 0.2, [(0.3, 1)], 0.4, log=log)
+    _log_one("b", 3.4, 3.5, 3.6, [(3.7, 1)], 3.8, log=log)
+    rep = evaluate_slo(log, SLOPolicy(ttft_s=1.0, window_s=1.0))
+    assert len(rep.windows) == 4
+    for w in rep.windows[1:3]:  # the silent middle
+        assert w["tokens"] == 0
+        assert all(w[m]["n"] == 0 for m in ("ttft_s", "tpot_s", "e2e_s"))
+        assert w["ttft_s"]["p95"] is None
+    assert not rep.exceeds()  # empty windows can never breach
+    # t_end extends the tiling (live "up to now" evaluation)
+    rep2 = evaluate_slo(log.snapshot(),
+                        SLOPolicy(ttft_s=1.0, window_s=1.0), t_end=5.5)
+    assert len(rep2.windows) == 6
+    # empty log: no windows, no breach, null goodput
+    rep3 = evaluate_slo(RequestLog(), SLOPolicy(ttft_s=1.0))
+    assert rep3.windows == [] and not rep3.exceeds()
+    assert rep3.goodput_frac is None
+
+
+def test_slo_policy_validation():
+    with pytest.raises(ValueError):
+        SLOPolicy()  # no targets
+    with pytest.raises(ValueError):
+        SLOPolicy(ttft_s=1.0, window_s=0.0)
+    with pytest.raises(ValueError):
+        SLOPolicy(ttft_s=1.0, percentile="p42")
+    # summary round-trips through JSON
+    rep = evaluate_slo(
+        _log_one("r", 0.0, 0.1, 0.2, [(0.3, 1)], 0.4),
+        SLOPolicy(e2e_s=9.0, percentile="p99"),
+    )
+    assert json.loads(json.dumps(rep.summary()))["schema"] == "dls.slo/1"
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: bounded rings, triggers, dump round-trip
+
+
+def test_ring_tracer_never_exceeds_capacity_and_evicts_in_order():
+    clk = FakeClock(0.0)
+    tr = RingTracer(4, clock=clk)
+    for i in range(10):
+        clk.t = float(i)
+        tr.counter("c", i)
+    assert len(tr.events) == 4  # bounded regardless of run length
+    assert [e["value"] for e in tr.events] == [6, 7, 8, 9]  # oldest out
+    # spans enter on close and evict the same way
+    ev = tr.begin("w")
+    tr.end(ev)
+    assert len(tr.events) == 4
+    assert [e.get("value", e["name"]) for e in tr.events] == [7, 8, 9, "w"]
+    with pytest.raises(ValueError):
+        RingTracer(0)
+
+
+def test_tee_tracer_mirrors_same_event_dicts():
+    prim = Tracer(clock=FakeClock(1.0))
+    ring = RingTracer(8, clock=FakeClock(1.0))
+    tee = TeeTracer(prim, ring)
+    ev = tee.begin("wave", track="decode", cat="decode")
+    tee.end(ev)
+    tee.complete("seg", 1.0, 2.0, track="decode")
+    tee.instant("retire", track="decode")
+    tee.counter("depth", 3)
+    assert len(prim.events) == 4 and len(ring.events) == 4
+    for a, b in zip(prim.events, ring.events):
+        assert a is b  # mirrored by reference, no copies
+    assert tee.tracks() == prim.tracks()
+    assert len(tee) == 4
+
+
+def test_flight_recorder_triggered_dump_roundtrip(tmp_path):
+    clk = FakeClock(0.0)
+    fr = FlightRecorder(capacity=16, request_capacity=4, clock=clk)
+    fr.tracer.complete("segment", 0.0, 0.5, track="decode", cat="decode")
+    fr.tracer.counter("decode.queue_depth", 2)
+    _log_one("r0", 0.0, 0.1, 2.5, [(2.6, 1)], 2.7, log=fr.reqlog)
+
+    # no breach, no other evidence -> no dump
+    ok = evaluate_slo(fr.reqlog, SLOPolicy(ttft_s=60.0))
+    assert fr.maybe_dump(str(tmp_path), slo_report=ok) is None
+
+    bad = evaluate_slo(fr.reqlog, SLOPolicy(ttft_s=1.0))
+    rec = fr.maybe_dump(str(tmp_path), slo_report=bad)
+    assert rec is not None
+    assert any("slo_breach" in r for r in rec["reasons"])
+    # the dumped trace is a loadable Perfetto file
+    assert validate_trace(rec["trace"]) == []
+    payload = json.load(open(rec["requests"]))
+    assert validate_request_log(payload["request_log"]) == []
+    assert payload["ring_capacity"] == 16
+    assert fr.dumps == [rec]
+
+
+def test_flight_triggers_near_oom_and_straggler():
+    class Drift:
+        headroom = {
+            "node0": {"headroom_frac": 0.05, "warn": True},
+            "node1": {"headroom_frac": 0.60},
+        }
+
+    class Att:
+        stragglers = ["node3"]
+
+    reasons = FlightRecorder.triggers(memdrift=Drift(), attribution=Att())
+    assert len(reasons) == 2
+    assert any(r.startswith("near_oom: node0") for r in reasons)
+    assert any(r == "straggler: node3" for r in reasons)
+    assert FlightRecorder.triggers() == []
+
+
+def test_ambient_flight_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DLS_FLIGHT", raising=False)
+    reset_ambient()
+    try:
+        assert not flight_enabled()
+        assert ambient_flight() is None
+        monkeypatch.setenv("DLS_FLIGHT", "1")
+        fr = ambient_flight()
+        assert fr is not None and ambient_flight() is fr
+    finally:
+        reset_ambient()
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: the bitwise record-vs-histogram contract
+
+
+def _build_engine(**obs):
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+    from distributed_llm_scheduler_tpu.models.kv_pages import PagePool
+
+    cfg = gpt2.GPT2Config.tiny()
+    slots, ps, n_pages, ppseq = 2, 8, 32, 4
+    dag = build_paged_decode_dag(
+        cfg, slots=slots, page_size=ps, n_pages=n_pages, pages_per_seq=ppseq
+    )
+    params = dag.init_params()
+    weights = {
+        k: v for k, v in params.items()
+        if not (k.startswith("cache_") or k == "page_table")
+    }
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+    eng = backend.paged_decode_engine(
+        dag.graph, sched, cfg, weights, pool,
+        slots=slots, pages_per_seq=ppseq, seg_steps=4, **obs,
+    )
+    return eng, pool
+
+
+def _scripted_run(eng, clk):
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    clk.t = 10.0
+    eng.submit("r0", prompt, 9)
+    clk.t = 12.0
+    eng.submit("r1", prompt, 9)
+    clk.t = 20.0
+    eng.step_segment()
+    clk.t = 24.0
+    eng.step_segment()
+
+
+def test_engine_records_bitwise_match_histograms(monkeypatch):
+    """TTFT/TPOT derived from RequestRecords must equal — bitwise, not
+    approximately — the samples the engine's histograms observed for
+    the same run (they come from the same clock reads)."""
+    monkeypatch.delenv("DLS_TRACE", raising=False)
+    monkeypatch.delenv("DLS_FLIGHT", raising=False)
+    reset_ambient()
+    clk = FakeClock(0.0)
+    reg = MetricsRegistry()
+    eng, pool = _build_engine(trace=Tracer(clock=clk), metrics=reg,
+                              clock=clk)
+    _scripted_run(eng, clk)
+
+    snap = eng.reqlog.snapshot()
+    assert validate_request_log(snap) == []
+    recs = {r["rid"]: r for r in snap["requests"]}
+    assert set(recs) == {"r0", "r1"}
+    # bitwise identity with the histogram reservoirs (order-insensitive)
+    ttft_samples = eng.metrics.histogram("decode.ttft_s")._samples
+    tpot_samples = eng.metrics.histogram("decode.tpot_s")._samples
+    assert sorted(r["ttft_s"] for r in recs.values()) == sorted(ttft_samples)
+    assert sorted(r["tpot_s"] for r in recs.values()) == sorted(tpot_samples)
+    # and the golden values themselves are exact under the scripted clock
+    assert recs["r0"]["ttft_s"] == 10.0 and recs["r1"]["ttft_s"] == 8.0
+    assert recs["r0"]["tpot_s"] == 0.5 and recs["r1"]["tpot_s"] == 0.5
+    assert recs["r0"]["queue_wait_s"] == 10.0
+    assert recs["r0"]["n_tokens"] == 9
+    assert recs["r0"]["deliveries"] == [[20.0, 1], [20.0, 4], [24.0, 4]]
+    # the SLO layer sees the run the same way
+    rep = evaluate_slo(snap, SLOPolicy(ttft_s=9.0, window_s=4.0))
+    assert rep.exceeds()  # r0 waited 10s > 9s
+    assert rep.worst_breach()["metric"] == "ttft_s"
+
+    # queue-depth dedup: the tracer counter track and the metrics gauge
+    # are fed by one helper, so their event sequences agree exactly
+    depth_track = [e["value"] for e in eng.tracer.events
+                   if e["type"] == "counter"
+                   and e["name"] == "decode.queue_depth"]
+    assert depth_track == [1, 2, 0, 0, 0]
+    gauge = reg.snapshot()["gauges"]["decode.queue_depth"]
+    assert gauge["value"] == depth_track[-1]
+    assert gauge["max"] == max(depth_track)
+
+
+def test_engine_instrumented_run_bit_identical_and_reset(monkeypatch):
+    """A flight-recorded run must produce bit-identical outputs and page
+    accounting to a bare run, and reset() starts a fresh request log
+    while the flight ring survives."""
+    monkeypatch.delenv("DLS_TRACE", raising=False)
+    monkeypatch.delenv("DLS_FLIGHT", raising=False)
+    reset_ambient()
+    clk_a = FakeClock(0.0)
+    eng_a, pool_a = _build_engine(clock=clk_a)
+    assert eng_a.tracer is None and eng_a.flight is None  # disabled path
+    _scripted_run(eng_a, clk_a)
+
+    clk_b = FakeClock(0.0)
+    fr = FlightRecorder(capacity=64, request_capacity=8, clock=clk_b)
+    eng_b, pool_b = _build_engine(clock=clk_b, flight=fr)
+    assert eng_b.tracer is fr.tracer  # the ring alone carries spans
+    _scripted_run(eng_b, clk_b)
+
+    assert set(eng_a.results) == set(eng_b.results)
+    for rid in eng_a.results:
+        np.testing.assert_array_equal(eng_a.results[rid],
+                                      eng_b.results[rid])
+    assert pool_a.free_pages == pool_b.free_pages
+    # the flight ring stayed within its bound and captured the run
+    assert len(fr.tracer.events) <= 64
+    assert len(fr.reqlog) <= 8
+    assert {r.rid for r in fr.reqlog.records()} == {"r0", "r1"}
+
+    # reset(): fresh engine log, surviving flight ring
+    old_log = eng_b.reqlog
+    eng_b.reset()
+    assert eng_b.reqlog is not old_log and len(eng_b.reqlog) == 0
+    assert len(fr.reqlog) == 2
+
+    # explicit tracer + flight -> teed, both sinks see the same events
+    clk_c = FakeClock(0.0)
+    tr = Tracer(clock=clk_c)
+    fr_c = FlightRecorder(capacity=64, clock=clk_c)
+    eng_c, _ = _build_engine(clock=clk_c, trace=tr, flight=fr_c)
+    assert isinstance(eng_c.tracer, TeeTracer)
+    _scripted_run(eng_c, clk_c)
+    assert len(tr.events) > 0
+    assert list(fr_c.tracer.events) == tr.events[-len(fr_c.tracer.events):]
+
+
+# ---------------------------------------------------------------------------
+# slo CLI exit codes (offline request-log mode: no device run)
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_slo_cli_exit_codes(tmp_path):
+    from distributed_llm_scheduler_tpu.__main__ import main
+
+    meets = _log_one("r0", 0.0, 0.1, 0.2, [(0.5, 3)], 0.6).snapshot()
+    ok_path = _write(tmp_path, "ok.json", meets)
+    assert main(["slo", "--requests", ok_path, "--ttft", "1.0"]) == 0
+    # breach: names the window and metric (exit 1)
+    assert main(["slo", "--requests", ok_path, "--ttft", "0.1"]) == 1
+    # malformed / empty / no-targets / unreadable -> 2
+    bad_path = _write(tmp_path, "bad.json", {"schema": "nope"})
+    assert main(["slo", "--requests", bad_path, "--ttft", "1.0"]) == 2
+    empty_path = _write(
+        tmp_path, "empty.json",
+        {"schema": "dls.requests/1", "requests": [], "evicted": 0},
+    )
+    assert main(["slo", "--requests", empty_path, "--ttft", "1.0"]) == 2
+    assert main(["slo", "--requests", ok_path]) == 2  # no targets
+    assert main(["slo", "--requests", str(tmp_path / "nope.json"),
+                 "--ttft", "1.0"]) == 2
+    # a flight-recorder dump is accepted directly
+    dump_path = _write(tmp_path, "dump.json",
+                       {"reasons": ["x"], "request_log": meets})
+    assert main(["slo", "--requests", dump_path, "--ttft", "1.0"]) == 0
+    # a decode-bench artifact's paged leg too
+    art_path = _write(tmp_path, "art.json",
+                      {"paged": {"requests": meets}})
+    assert main(["slo", "--requests", art_path, "--e2e", "0.1"]) == 1
