@@ -1340,6 +1340,127 @@ def cmd_slo(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Online serving run: open-loop arrivals (seeded Poisson or a
+    ``dls.arrivals/1`` trace) through the event-loop front-end over the
+    paged decode engine on a virtual clock — SLO-aware admission and
+    priority preemption when ``--admission slo`` (the default).  Exit 0
+    when the run meets the policy, 1 on SLO breach (flight rings dumped
+    to --flight-dir when given), 2 on malformed traces / policies /
+    configurations."""
+    from .obs import FlightRecorder, SLOPolicy
+    from .serve import (
+        ServiceTimeModel,
+        ServingFrontend,
+        VirtualClock,
+        load_trace,
+        poisson_arrivals,
+        save_trace,
+    )
+
+    try:
+        policy = SLOPolicy(
+            ttft_s=args.ttft, tpot_s=args.tpot, e2e_s=args.e2e,
+            window_s=args.window, percentile=args.percentile,
+        )
+    except ValueError as e:
+        print(f"serve: {e} (pass --ttft/--tpot/--e2e)", file=sys.stderr)
+        return 2
+    if args.admission == "slo" and policy.ttft_s is None:
+        print("serve: slo admission needs a --ttft target",
+              file=sys.stderr)
+        return 2
+
+    if args.trace:
+        try:
+            arrivals = load_trace(args.trace)
+        except (OSError, ValueError) as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 2
+    else:
+        try:
+            arrivals = poisson_arrivals(
+                args.rate, args.n_requests, args.seed,
+                prompt_lens=(8, 16), max_new_tokens=(8, 16),
+                priorities=(0, 1), priority_weights=(0.3, 0.7),
+            )
+        except ValueError as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 2
+    if args.save_trace:
+        save_trace(arrivals, args.save_trace)
+        print(f"serve: trace -> {args.save_trace}", file=sys.stderr)
+
+    cfg = _config_from(args)
+    if _weights_family(cfg.model) != "gpt2":
+        print("serve: needs a gpt2-family model (paged decode)",
+              file=sys.stderr)
+        return 2
+    slots, ps, n_pages, ppseq = 4, 8, 13, 4
+    too_big = [a.rid for a in arrivals
+               if a.prompt_len + a.max_new_tokens > ppseq * ps]
+    if too_big:
+        print(f"serve: {len(too_big)} arrival(s) exceed the per-request "
+              f"KV capacity of {ppseq * ps} tokens (first: "
+              f"{too_big[0]!r})", file=sys.stderr)
+        return 2
+
+    import jax
+
+    from .backends.device import DeviceBackend
+    from .core.cluster import Cluster
+    from .frontend.decode_dag import build_paged_decode_dag
+    from .models.kv_pages import PagePool
+
+    clock = VirtualClock()
+    flight = FlightRecorder(clock=clock)
+    mcfg = cfg.model_config()
+    ddag = build_paged_decode_dag(
+        mcfg, slots=slots, page_size=ps, n_pages=n_pages,
+        pages_per_seq=ppseq,
+    )
+    params = ddag.init_params()
+    weights = {k: v for k, v in params.items()
+               if not (k.startswith("cache_") or k == "page_table")}
+    dcluster = Cluster.from_jax_devices(jax.devices()[:1])
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+    eng = DeviceBackend(dcluster).paged_decode_engine(
+        ddag.graph, cfg.build_scheduler().schedule(ddag.graph, dcluster),
+        mcfg, weights, pool, slots=slots, pages_per_seq=ppseq,
+        seg_steps=4, clock=clock, flight=flight,
+    )
+    fe = ServingFrontend(
+        eng, arrivals, policy, admission=args.admission,
+        preemption=not args.no_preempt,
+        time_model=ServiceTimeModel(),
+    )
+    report = fe.run()
+
+    out = {k: v for k, v in report.items() if k != "requests"}
+    if report["breached"] and args.flight_dir:
+        from .obs.export import validate_trace
+
+        rec = flight.maybe_dump(args.flight_dir,
+                                slo_report=fe.slo_report)
+        out["flight_dump"] = dict(
+            rec, trace_valid=validate_trace(rec["trace"]) == []
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"serve: report -> {args.out}", file=sys.stderr)
+    print(json.dumps(out, indent=1, sort_keys=True))
+    if report["breached"]:
+        b = fe.slo_report.worst_breach()
+        print(
+            f"serve: {b['metric']} {b['percentile']}={b['value']:.6g}s "
+            f"exceeds target {b['target']:.6g}s in window {b['window']} "
+            f"[{b['t_start']:.3f}s, {b['t_end']:.3f}s)", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_doctor(args) -> int:
     """Run doctor: measured critical-path attribution (+ cost-model
     drift when the run is live).  ``--trace`` diagnoses an exported
@@ -1835,6 +1956,52 @@ def main(argv=None) -> int:
                    help="live mode: on breach, dump the flight-recorder "
                         "rings (Perfetto trace + request log) here")
     p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
+        "serve",
+        help="online serving run on a virtual clock: open-loop arrivals "
+             "through the SLO-aware front-end over the paged decode "
+             "engine (exit 1 on SLO breach, 2 on malformed input)",
+    )
+    _add_common(p)
+    p.add_argument("--rate", type=float, default=40.0, metavar="RPS",
+                   help="offered load for the seeded Poisson generator "
+                        "(default 40.0 req/s; ignored with --trace)")
+    p.add_argument("--requests", type=int, default=32, dest="n_requests",
+                   help="number of arrivals to generate (default 32; "
+                        "ignored with --trace)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="replay this dls.arrivals/1 trace instead of "
+                        "generating arrivals (malformed -> exit 2)")
+    p.add_argument("--save-trace", default=None, dest="save_trace",
+                   metavar="PATH",
+                   help="write the arrival schedule as a dls.arrivals/1 "
+                        "trace for exact replay")
+    p.add_argument("--admission", default="slo", choices=("slo", "fifo"),
+                   help="admission policy: slo (shed/defer low tiers on "
+                        "TTFT-window breach; default) or fifo admit-all")
+    p.add_argument("--no-preempt", action="store_true", dest="no_preempt",
+                   help="disable priority preemption (slo admission only)")
+    p.add_argument("--ttft", type=float, default=2.0, metavar="SECONDS",
+                   help="per-window TTFT target at --percentile "
+                        "(default 2.0)")
+    p.add_argument("--tpot", type=float, default=None, metavar="SECONDS",
+                   help="per-window TPOT (inter-token) target")
+    p.add_argument("--e2e", type=float, default=None, metavar="SECONDS",
+                   help="per-window end-to-end latency target")
+    p.add_argument("--window", type=float, default=0.5, metavar="SECONDS",
+                   help="sliding virtual-time window size (default 0.5)")
+    p.add_argument("--percentile", default="p95",
+                   choices=("p50", "p95", "p99"),
+                   help="which per-window quantile gates (default p95)")
+    p.add_argument("--flight-dir", default=None, dest="flight_dir",
+                   metavar="DIR",
+                   help="on breach, dump the flight-recorder rings "
+                        "(Perfetto trace + request log) here")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="write the full serving report (including "
+                        "per-request rows) here")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
         "doctor",

@@ -491,9 +491,71 @@ class PagedDecodeEngine:
         if self.tracer is not None:
             self.tracer.counter("decode.queue_depth", depth)
 
+    # -- pool headroom (ONE surface) ---------------------------------------
+    @property
+    def free_slots(self) -> int:
+        """Batch lanes currently unoccupied."""
+        return sum(1 for r in self._slot_req if r is None)
+
+    def page_occupancy(self) -> Dict[str, Any]:
+        """Pool headroom as a first-class surface: free/used totals plus
+        per-request page counts.  The serving frontend's admission check,
+        the engine summary, and the ``decode.page_pool`` metric/trace
+        tracks all read THIS dict, so they cannot disagree."""
+        per_request = {
+            str(self._slot_req[s]): len(self._slot_pages[s])
+            for s in range(self.slots)
+            if self._slot_req[s] is not None
+        }
+        return {
+            "n_pages": self.pool.n_pages - 1,  # page 0 is the trash page
+            "free_pages": self.pool.free_pages,
+            "used_pages": self.pool.used_pages,
+            "per_request": per_request,
+        }
+
+    def _emit_pool_occupancy(self) -> None:
+        """Sample :meth:`page_occupancy` into the ``decode.page_pool``
+        gauge and (when tracing) counter track."""
+        used = self.page_occupancy()["used_pages"]
+        self.metrics.gauge(
+            "decode.page_pool_occupancy_pages", unit="pages"
+        ).set(used)
+        if self.tracer is not None:
+            self.tracer.counter("decode.page_pool_occupancy_pages", used)
+
+    def summary(self) -> Dict[str, Any]:
+        """Engine-state snapshot: slot/queue/pool headroom at this
+        segment boundary (what admission policies key off)."""
+        return {
+            "slots": self.slots,
+            "free_slots": self.free_slots,
+            "queued": len(self._queue),
+            "in_flight": self.slots - self.free_slots,
+            "completed": len(self.results),
+            "segments_run": self.segments_run,
+            "page_occupancy": self.page_occupancy(),
+        }
+
     def submit(self, rid: Any, prompt_ids: Any, max_new_tokens: int) -> None:
         """Queue a request; admitted into a free slot (and its pages
-        allocated) at the next segment boundary."""
+        allocated) at the next segment boundary.
+
+        Request ids must be unique for the life of the engine state: a
+        duplicate would silently clobber ``_submit_t``/``results`` and
+        collide lifecycle-log rows, so it is a hard error.  A PREEMPTED
+        rid is also spent — the serving layer re-queues the generated
+        prefix under a derived rid (``reset()`` clears everything)."""
+        if rid in self.results:
+            raise ValueError(f"duplicate rid {rid!r}: already retired")
+        if rid in self._tokens:
+            raise ValueError(f"duplicate rid {rid!r}: already in flight")
+        if any(q[0] == rid for q in self._queue):
+            raise ValueError(f"duplicate rid {rid!r}: already queued")
+        if self.reqlog.get(rid) is not None:
+            raise ValueError(
+                f"duplicate rid {rid!r}: already has a lifecycle record"
+            )
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
             raise ValueError("prompt_ids must be (1, prompt_len)")
@@ -655,15 +717,8 @@ class PagedDecodeEngine:
             self.metrics.counter("decode.admission_waves").inc()
             if ev_wave is not None:
                 self.tracer.end(ev_wave)
-            if self.tracer is not None:
-                self.tracer.counter(
-                    "decode.page_pool_occupancy_pages", self.pool.used_pages
-                )
+            self._emit_pool_occupancy()
             self._emit_queue_depth()
-        if admitted:
-            self.metrics.gauge(
-                "decode.page_pool_occupancy_pages", unit="pages"
-            ).set(self.pool.used_pages)
         return admitted
 
     def _retire(self, s: int) -> None:
@@ -696,6 +751,61 @@ class PagedDecodeEngine:
                 "retire", track="decode", cat="decode", t=t_ret,
                 rid=str(rid), tokens=n,
             )
+
+    def preempt(self, rid: Any) -> Dict[str, Any]:
+        """Evict an in-flight request: free its pages back to the pool
+        and hand the generated prefix to the caller for re-queueing.
+
+        Preemption is the capacity lever priority scheduling needs: a
+        high-tier arrival that cannot be admitted (no free slot, no free
+        pages) reclaims a low-tier slot NOW instead of waiting out its
+        decode.  No progress is lost — greedy decode is deterministic,
+        so re-submitting ``prompt + tokens`` (under a new rid) with the
+        returned ``remaining`` budget reproduces the exact continuation
+        an unpreempted run of that prompt would generate (asserted by
+        ``tests/test_serve.py``).
+
+        Only valid between segments, for a rid currently occupying a
+        slot (queued/retired rids raise — nothing to evict).  Returns
+        ``{"rid", "tokens", "remaining"}``: ``tokens`` the (k,) int32
+        generated prefix (prefill token included), ``remaining`` the
+        decode steps still owed.  The lifecycle record ends in the
+        terminal ``preempted`` state.
+        """
+        from ..models.kv_pages import TRASH_PAGE
+
+        slot = next(
+            (s for s in range(self.slots) if self._slot_req[s] == rid),
+            None,
+        )
+        if slot is None:
+            raise ValueError(f"rid {rid!r} is not in flight")
+        tokens = self._np.asarray(
+            self._tokens.pop(rid), dtype=self._np.int32
+        )
+        remaining = int(self.remaining[slot])
+        self.pool.free(self._slot_pages[slot])
+        if self.memprof is not None:
+            self.memprof.free(self._mem_node, f"kv:{rid}")
+        self.page_table[slot] = TRASH_PAGE
+        self.lengths[slot] = 0
+        self.cur_tok[slot, 0] = 0
+        self.remaining[slot] = 0
+        self._slot_req[slot] = None
+        self._slot_pages[slot] = []
+        self._first_tok_t.pop(rid, None)
+        t_pre = self._clock()
+        for rl in self._reqlogs:
+            rl.preempt(rid, t_pre)
+        self.metrics.counter("decode.requests_preempted").inc()
+        if self.tracer is not None:
+            self.tracer.instant(
+                "preempt", track="decode", cat="decode", t=t_pre,
+                rid=str(rid), tokens=int(tokens.shape[0]),
+                remaining=remaining,
+            )
+        self._emit_pool_occupancy()
+        return {"rid": rid, "tokens": tokens, "remaining": remaining}
 
     # -- the serving loop --------------------------------------------------
     def step_segment(self) -> int:
@@ -742,13 +852,7 @@ class PagedDecodeEngine:
         self.segments_run += 1
         self.metrics.counter("decode.segments_run").inc()
         self.metrics.counter("decode.tokens_delivered").inc(delivered)
-        self.metrics.gauge(
-            "decode.page_pool_occupancy_pages", unit="pages"
-        ).set(self.pool.used_pages)
-        if self.tracer is not None:
-            self.tracer.counter(
-                "decode.page_pool_occupancy_pages", self.pool.used_pages
-            )
+        self._emit_pool_occupancy()
         self._emit_queue_depth()
         return delivered
 

@@ -39,6 +39,8 @@ LOWER_BETTER = (
     "kv_pages_peak",
     "singlechip_replay_ms",
     "fence_rtt_ms",
+    "serve.ttft_p99_ms",
+    "serve.queue_wait_p95_ms",
 )
 
 # lower-is-better metric FAMILIES, matched by prefix: per-device peak
@@ -55,12 +57,19 @@ METRIC_DEFAULT_TOLERANCES = {
     "peak_hbm_gb_modeled": 0.02,
     "peak_hbm_bytes": 0.02,
     "kv_pages_peak": 0.0,
+    # serve bench metrics run on a VirtualClock — every timestamp is a
+    # deterministic function of the seed, so any drift is a behavior
+    # change, not noise
+    "serve.goodput_tok_s": 0.0,
+    "serve.ttft_p99_ms": 0.0,
+    "serve.queue_wait_p95_ms": 0.0,
 }
 HIGHER_BETTER = (
     "vs_baseline",
     "mfu_single_chip",
     "mfu_segmented",
     "mfu_compiled",
+    "serve.goodput_tok_s",
 )
 BOOL_METRICS = ("oracle_ok",)
 
@@ -79,6 +88,9 @@ DEFAULT_METRICS = (
     "mfu_segmented",
     "mfu_compiled",
     "oracle_ok",
+    "serve.goodput_tok_s",
+    "serve.ttft_p99_ms",
+    "serve.queue_wait_p95_ms",
 )
 
 DEFAULT_TOLERANCE = 0.10

@@ -1,0 +1,345 @@
+"""Open-loop serving benchmark: SLO-aware admission + priority
+preemption vs FIFO admit-all at equal offered load.
+
+The decode bench (:mod:`.decode_bench`) measures the paged engine with
+pre-staged requests — it cannot see the failure mode serving actually
+has, which is QUEUEING: under overload, admit-all keeps every request
+but blows every TTFT, so almost none of the delivered tokens count as
+goodput.  This bench runs the same Poisson arrival schedule
+(:mod:`..serve.loadgen`) through the :class:`~..serve.frontend.
+ServingFrontend` twice — ``fifo`` admit-all, then ``slo`` admission
+with priority preemption — on a shared :class:`~..serve.frontend.
+VirtualClock` + :class:`~..serve.frontend.ServiceTimeModel`, so the
+whole run (timestamps, windows, shed/preempt decisions, tokens) is a
+deterministic function of the seed: the comparison is a property of the
+POLICIES, not of host jitter, and CI can gate it exactly.
+
+Gates (exit 1 from ``main`` on violation):
+
+* goodput: the slo leg's tokens/s-within-SLO strictly exceeds fifo's
+  at equal offered load,
+* mechanism: the slo leg actually preempted (the scenario is tuned so
+  tier-0 arrivals hit a full pool),
+* zero leaked pages on both legs,
+* determinism: a same-seed repeat of the slo leg digests identically.
+
+The artifact schema is ``dls.serve/1`` (validated by
+:func:`validate_serve_artifact`; schema-gated in
+``tests/test_artifacts_schema.py``), with the regression-gated metrics
+flattened at top level: ``serve.goodput_tok_s`` (higher-better),
+``serve.ttft_p99_ms`` / ``serve.queue_wait_p95_ms`` (lower-better) —
+wired into :mod:`.regress` defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+SCHEMA = "dls.serve/1"
+
+#: the tuned overload scenario: ~2x the virtual-time service capacity
+#: (4 slots x 4 tokens / 50 ms segment), page-contended (12 allocatable
+#: pages vs 3-4 per request) so tier-0 arrivals exercise preemption
+SCENARIO = {
+    "slots": 4,
+    "page_size": 8,
+    "n_pages": 13,
+    "pages_per_seq": 4,
+    "seg_steps": 4,
+    "rate_rps": 40.0,
+    "n_requests": 32,
+    "prompt_lens": (8, 16),
+    "max_new_tokens": (8, 16),
+    "priorities": (0, 1),
+    "priority_weights": (0.3, 0.7),
+    "ttft_s": 0.15,
+    "window_s": 0.2,
+    "percentile": "p95",
+    "wave_s": 0.01,
+    "segment_s": 0.05,
+    "idle_s": 0.005,
+}
+
+
+def build_serve_engine(
+    slots: int = 4,
+    page_size: int = 8,
+    n_pages: int = 13,
+    pages_per_seq: int = 4,
+    seg_steps: int = 4,
+    clock: Any = None,
+    flight: Any = None,
+    metrics: Any = None,
+):
+    """One tiny-GPT2 paged engine on the first CPU/TPU device, built
+    through ``DeviceBackend.paged_decode_engine`` (pre-execution gate
+    included) — the same construction the slo CLI and tests use."""
+    import jax
+
+    from ..backends.device import DeviceBackend
+    from ..core.cluster import Cluster
+    from ..frontend.decode_dag import build_paged_decode_dag
+    from ..models import gpt2
+    from ..models.kv_pages import PagePool
+    from ..sched.policies import get_scheduler
+
+    cfg = gpt2.GPT2Config.tiny()
+    dag = build_paged_decode_dag(
+        cfg, slots=slots, page_size=page_size, n_pages=n_pages,
+        pages_per_seq=pages_per_seq,
+    )
+    params = dag.init_params()
+    weights = {
+        k: v for k, v in params.items()
+        if not (k.startswith("cache_") or k == "page_table")
+    }
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    pool = PagePool(n_pages=n_pages, page_size=page_size)
+    eng = DeviceBackend(cluster).paged_decode_engine(
+        dag.graph, sched, cfg, weights, pool,
+        slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
+        clock=clock, flight=flight, metrics=metrics,
+    )
+    return eng, pool
+
+
+def run_serving_leg(
+    arrivals: Sequence[Any],
+    policy: Any,
+    admission: str,
+    preemption: bool,
+    time_model: Any,
+    scenario: Optional[Dict[str, Any]] = None,
+    engine: Any = None,
+) -> Dict[str, Any]:
+    """One frontend run over a clean engine + VirtualClock at t=0;
+    returns the frontend report with the run digest attached.
+
+    Pass a warmed ``engine`` (built with a VirtualClock) to skip
+    recompilation — it is reset, and its clock rewound to 0, so the leg
+    sees exactly the state a fresh build would."""
+    from ..serve.frontend import ServingFrontend, VirtualClock
+
+    if engine is None:
+        sc = dict(SCENARIO, **(scenario or {}))
+        engine, _pool = build_serve_engine(
+            slots=sc["slots"], page_size=sc["page_size"],
+            n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
+            seg_steps=sc["seg_steps"], clock=VirtualClock(),
+        )
+    else:
+        engine.reset()
+        engine._clock.reset()
+    fe = ServingFrontend(
+        engine, arrivals, policy, admission=admission,
+        preemption=preemption, time_model=time_model,
+    )
+    leg = fe.run()
+    leg["digest"] = fe.digest()
+    return leg
+
+
+def measure_serving(seed: int = 7,
+                    scenario: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """The full comparison: fifo admit-all vs slo+preemption on the
+    same arrival schedule, plus a same-seed determinism repeat of the
+    slo leg.  Returns the ``dls.serve/1`` artifact dict."""
+    from ..obs.slo import SLOPolicy
+    from ..serve.frontend import ServiceTimeModel
+    from ..serve.loadgen import poisson_arrivals, schedule_digest
+
+    sc = dict(SCENARIO, **(scenario or {}))
+    arrivals = poisson_arrivals(
+        sc["rate_rps"], sc["n_requests"], seed,
+        prompt_lens=sc["prompt_lens"],
+        max_new_tokens=sc["max_new_tokens"],
+        priorities=sc["priorities"],
+        priority_weights=sc["priority_weights"],
+    )
+    policy = SLOPolicy(
+        ttft_s=sc["ttft_s"], window_s=sc["window_s"],
+        percentile=sc["percentile"],
+    )
+    tm = ServiceTimeModel(
+        wave_s=sc["wave_s"], segment_s=sc["segment_s"],
+        idle_s=sc["idle_s"],
+    )
+    from ..serve.frontend import VirtualClock
+
+    eng, _pool = build_serve_engine(
+        slots=sc["slots"], page_size=sc["page_size"],
+        n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
+        seg_steps=sc["seg_steps"], clock=VirtualClock(),
+    )
+    fifo = run_serving_leg(arrivals, policy, "fifo", False, tm, sc,
+                           engine=eng)
+    slo = run_serving_leg(arrivals, policy, "slo", True, tm, sc,
+                          engine=eng)
+    repeat = run_serving_leg(arrivals, policy, "slo", True, tm, sc,
+                             engine=eng)
+    deterministic = slo["digest"] == repeat["digest"]
+    return {
+        "schema": SCHEMA,
+        "seed": seed,
+        "scenario": {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in sc.items()
+        },
+        "offered_load": {
+            "rate_rps": sc["rate_rps"],
+            "n_requests": sc["n_requests"],
+            "arrival_span_s": arrivals[-1].t,
+            "schedule_digest": schedule_digest(arrivals),
+        },
+        "policy": policy.to_json(),
+        "time_model": tm.to_json(),
+        "legs": {"fifo_admit_all": fifo, "slo_preempt": slo},
+        "deterministic": deterministic,
+        "goodput_gain_vs_fifo": (
+            slo["goodput_tok_s"] / fifo["goodput_tok_s"]
+            if fifo["goodput_tok_s"] else None
+        ),
+        "pages_leaked": fifo["pages_leaked"] + slo["pages_leaked"],
+        # the regression-gated serve metric family (eval/regress.py)
+        "serve.goodput_tok_s": slo["goodput_tok_s"],
+        "serve.ttft_p99_ms": slo["ttft_p99_ms"],
+        "serve.queue_wait_p95_ms": slo["queue_wait_p95_ms"],
+    }
+
+
+def gate_failures(art: Dict[str, Any]) -> List[str]:
+    """The acceptance gates, as human-readable failure strings."""
+    failures: List[str] = []
+    fifo = art["legs"]["fifo_admit_all"]
+    slo = art["legs"]["slo_preempt"]
+    if not slo["goodput_tok_s"] > fifo["goodput_tok_s"]:
+        failures.append(
+            f"slo goodput {slo['goodput_tok_s']:.1f} tok/s not strictly "
+            f"above fifo {fifo['goodput_tok_s']:.1f} tok/s"
+        )
+    if slo["preemptions"] < 1:
+        failures.append("slo leg never preempted (scenario mis-tuned)")
+    if art["pages_leaked"]:
+        failures.append(f"{art['pages_leaked']} pages leaked")
+    if not art["deterministic"]:
+        failures.append("same-seed repeat diverged (digest mismatch)")
+    return failures
+
+
+# -- artifact schema -------------------------------------------------------
+_LEG_REQUIRED = (
+    "admission", "preemption", "n_requests", "completed", "shed",
+    "preemptions", "tokens_total", "tokens_good", "makespan_s",
+    "goodput_tok_s", "throughput_tok_s", "ttft_p50_ms", "ttft_p99_ms",
+    "queue_wait_p95_ms", "pages_leaked", "breached", "requests",
+    "digest",
+)
+_TOP_REQUIRED = (
+    "schema", "seed", "scenario", "offered_load", "policy", "time_model",
+    "legs", "deterministic", "pages_leaked", "serve.goodput_tok_s",
+    "serve.ttft_p99_ms", "serve.queue_wait_p95_ms",
+)
+
+
+def validate_serve_artifact(art: Any) -> List[str]:
+    """Structural check of a ``dls.serve/1`` artifact; returns
+    human-readable problems (empty list == valid).  Shared by the
+    artifact schema tests and the CI serve-smoke step."""
+    errs: List[str] = []
+    if not isinstance(art, dict):
+        return [f"artifact is {type(art).__name__}, not dict"]
+    if art.get("schema") != SCHEMA:
+        errs.append(f"schema is {art.get('schema')!r}, want {SCHEMA!r}")
+    for f in _TOP_REQUIRED:
+        if f not in art:
+            errs.append(f"missing top-level field {f!r}")
+    legs = art.get("legs")
+    if not isinstance(legs, dict):
+        return errs + ["legs block missing or not a dict"]
+    for name in ("fifo_admit_all", "slo_preempt"):
+        leg = legs.get(name)
+        if not isinstance(leg, dict):
+            errs.append(f"legs.{name} missing or not a dict")
+            continue
+        for f in _LEG_REQUIRED:
+            if f not in leg:
+                errs.append(f"legs.{name} missing {f!r}")
+        reqs = leg.get("requests")
+        if not isinstance(reqs, list) or not reqs:
+            errs.append(f"legs.{name}.requests missing or empty")
+            continue
+        for i, row in enumerate(reqs):
+            if not isinstance(row, dict):
+                errs.append(f"legs.{name}.requests[{i}] not a dict")
+                continue
+            for f in ("rid", "priority", "state", "t_submit", "n_tokens",
+                      "preemptions"):
+                if f not in row:
+                    errs.append(f"legs.{name}.requests[{i}] missing {f!r}")
+    for f in ("serve.goodput_tok_s", "serve.ttft_p99_ms",
+              "serve.queue_wait_p95_ms"):
+        v = art.get(f)
+        if f in art and not isinstance(v, (int, float)):
+            errs.append(f"{f} is not numeric")
+    return errs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        description="open-loop serving bench: slo+preempt vs fifo "
+                    "admit-all (exit 1 when a gate fails)"
+    )
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rate", type=float, default=None,
+                    help="override offered load (requests/s)")
+    ap.add_argument("--requests", type=int, default=None, dest="n_requests",
+                    help="override request count")
+    ap.add_argument("--out", default=None,
+                    help="also write the dls.serve/1 artifact here")
+    args = ap.parse_args(argv)
+
+    overrides: Dict[str, Any] = {}
+    if args.rate is not None:
+        overrides["rate_rps"] = args.rate
+    if args.n_requests is not None:
+        overrides["n_requests"] = args.n_requests
+    art = measure_serving(seed=args.seed, scenario=overrides or None)
+    print(json.dumps(
+        {k: v for k, v in art.items() if k != "legs"}
+        | {"legs": {
+            name: {k: v for k, v in leg.items() if k != "requests"}
+            for name, leg in art["legs"].items()
+        }},
+        indent=1, sort_keys=True,
+    ))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(art, f, indent=1, sort_keys=True)
+    failures = gate_failures(art)
+    for f_ in failures:
+        print(f"SERVE GATE FAIL: {f_}", file=sys.stderr)
+    if failures:
+        return 1
+    slo = art["legs"]["slo_preempt"]
+    fifo = art["legs"]["fifo_admit_all"]
+    print(
+        f"SERVE GATES PASS: {slo['goodput_tok_s']:.0f} tok/s goodput "
+        f"(slo+preempt) vs {fifo['goodput_tok_s']:.0f} (fifo admit-all) "
+        f"at {art['scenario']['rate_rps']:.0f} req/s offered, "
+        f"{slo['preemptions']} preemptions, {slo['shed']} shed, "
+        "0 pages leaked, deterministic",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

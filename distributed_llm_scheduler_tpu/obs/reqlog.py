@@ -50,10 +50,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 SCHEMA = "dls.requests/1"
 
 #: lifecycle states in order; ``queued`` is entered at submit time (the
-#: engine's queue append IS the submission seam) so both carry t_submit
+#: engine's queue append IS the submission seam) so both carry t_submit.
+#: ``preempted`` is a terminal state for the ENGINE's record: the pages
+#: went back to the pool and the serving layer re-queues the generated
+#: prefix under a new rid (the resumed pass is a fresh record).
 STATES = (
     "submitted", "queued", "admitted", "prefill_done", "decoding",
-    "retired",
+    "preempted", "retired",
 )
 
 
@@ -63,7 +66,7 @@ class RequestRecord:
 
     __slots__ = (
         "rid", "prompt_len", "max_new_tokens", "state",
-        "t_submit", "t_admit", "t_first_token", "t_retire",
+        "t_submit", "t_admit", "t_first_token", "t_retire", "t_preempt",
         "n_tokens", "deliveries",
     )
 
@@ -77,6 +80,7 @@ class RequestRecord:
         self.t_admit: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_retire: Optional[float] = None
+        self.t_preempt: Optional[float] = None
         self.n_tokens = 0
         # (t_fold, n_tokens) per host observation of delivered tokens;
         # the first entry is the prefill readback (the TTFT anchor)
@@ -123,6 +127,7 @@ class RequestRecord:
             "t_admit": self.t_admit,
             "t_first_token": self.t_first_token,
             "t_retire": self.t_retire,
+            "t_preempt": self.t_preempt,
             "n_tokens": self.n_tokens,
             "deliveries": [[t, n] for t, n in self.deliveries],
             "queue_wait_s": self.queue_wait_s,
@@ -200,13 +205,23 @@ class RequestLog:
             rec.state = "retired"
             rec.t_retire = t
 
+    def preempt(self, rid: Any, t: float) -> None:
+        """Eviction seam: the request's pages went back to the pool and
+        its generated prefix is re-queued by the serving layer under a
+        NEW rid — this record is terminal (tokens it delivered stay
+        counted; TTFT evidence stays anchored at the first pass)."""
+        rec = self._records.get(rid)
+        if rec is not None:
+            rec.state = "preempted"
+            rec.t_preempt = t
+
     def _evict(self) -> None:
         if self.capacity is None:
             return
         while len(self._records) > self.capacity:
             victim = next(
                 (rid for rid, r in self._records.items()
-                 if r.state == "retired"),
+                 if r.state in ("retired", "preempted")),
                 None,
             )
             if victim is None:  # everything in flight: keep (rare; the
@@ -267,6 +282,16 @@ def validate_request_log(snap: Any) -> List[str]:
             for f in ("t_admit", "t_first_token", "t_retire"):
                 if row.get(f) is None:
                     errs.append(f"requests[{i}] retired but {f} is null")
+        if row.get("state") == "preempted":
+            # only an admitted request holds pages to evict, and the
+            # prefill delivered its first token before any segment ran
+            for f in ("t_admit", "t_first_token"):
+                if row.get(f) is None:
+                    errs.append(f"requests[{i}] preempted but {f} is null")
+            if row.get("t_retire") is not None:
+                errs.append(
+                    f"requests[{i}] preempted but t_retire is set"
+                )
         dl = row.get("deliveries")
         if isinstance(dl, list):
             if not all(

@@ -1,0 +1,32 @@
+"""Online serving layer: open-loop load generation (:mod:`.loadgen`)
+and the event-loop front-end with SLO-aware admission and priority
+preemption (:mod:`.frontend`) over the paged continuous-batching
+decode engine.  See ``docs/SERVING.md``."""
+
+from .frontend import ServiceTimeModel, ServingFrontend, VirtualClock
+from .loadgen import (
+    Arrival,
+    TRACE_SCHEMA,
+    arrivals_to_json,
+    load_trace,
+    poisson_arrivals,
+    prompt_token_ids,
+    save_trace,
+    schedule_digest,
+    validate_trace_obj,
+)
+
+__all__ = [
+    "Arrival",
+    "ServiceTimeModel",
+    "ServingFrontend",
+    "TRACE_SCHEMA",
+    "VirtualClock",
+    "arrivals_to_json",
+    "load_trace",
+    "poisson_arrivals",
+    "prompt_token_ids",
+    "save_trace",
+    "schedule_digest",
+    "validate_trace_obj",
+]

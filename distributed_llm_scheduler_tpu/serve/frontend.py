@@ -1,0 +1,541 @@
+"""Event-loop serving front-end over the paged continuous-batching engine.
+
+The :class:`~..backends.decode_loop.PagedDecodeEngine` is synchronously
+driven: callers pre-stage requests and ``run()`` drains them.  This
+module turns it into an ONLINE server: a single-threaded event loop
+(:class:`ServingFrontend`) injects open-loop arrivals (:mod:`.loadgen`)
+as their deadlines pass, holds the not-yet-admitted work in its own
+request queue, and drives the engine one ``step_segment()`` at a time —
+the engine's incremental API is the event granularity, so admission,
+preemption, and SLO control all act at segment boundaries, exactly
+where the engine's host-side state is mutable.
+
+Three policies compose per tick:
+
+* **Admission** — ``"fifo"`` (admit-all: every arrival goes straight to
+  the engine's FIFO queue; the baseline that collapses under overload)
+  or ``"slo"``: the frontend submits only what the engine can admit at
+  THIS boundary (reading :meth:`PagedDecodeEngine.page_occupancy` and
+  ``free_slots`` — the same headroom surface the metrics sample), and
+  uses :func:`~..obs.slo.evaluate_slo` window stats over the serving
+  log as the control signal: while the current p95 TTFT window
+  breaches, low-priority (tier > 0) work is DEFERRED, and a low-tier
+  request whose wait has already blown the TTFT target is SHED — it can
+  no longer produce goodput, so running it would only steal pages from
+  requests that still can.
+* **Preemption** — a tier-0 arrival that cannot be admitted (no free
+  slot / pages) evicts the lowest-tier in-flight victims via
+  :meth:`PagedDecodeEngine.preempt`: pages return to the pool, the
+  victim's generated prefix becomes the new prompt of a re-queued
+  resume pass (engine rid ``{rid}#p{k}``), and greedy determinism makes
+  the resumed continuation bitwise-identical to an unpreempted run of
+  the same prompt+prefix.
+* **Time** — with a :class:`VirtualClock` on the engine, the loop
+  advances time itself via a :class:`ServiceTimeModel` (per admission
+  wave, per segment, per idle tick), which makes every timestamp,
+  every window, every admission/shed/preempt decision, and therefore
+  the whole serving run a deterministic function of the seed — the
+  property the serve bench's repeat gate asserts.  With a real clock
+  the same loop serves wall-clock arrivals (sleeping while idle).
+
+The per-request truth lives in :meth:`request_rows`: one row per
+LOGICAL request (passes stitched across preemptions), with ``t_submit``
+anchored at the open-loop ARRIVAL time — so queue-wait and TTFT charge
+the frontend's own queueing, not just the engine's.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs.reqlog import _percentiles
+from ..obs.slo import SLOPolicy, SLOReport, evaluate_slo
+from .loadgen import Arrival, prompt_token_ids
+
+
+class VirtualClock:
+    """Deterministic logical clock: reads are pure, time moves only via
+    :meth:`advance`.  Share one instance between the engine and the
+    frontend so lifecycle timestamps and arrival deadlines live on the
+    same (simulated) timeline."""
+
+    def __init__(self, t0: float = 0.0):
+        self._t = float(t0)
+
+    def __call__(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError(f"cannot advance clock by {dt}")
+        self._t += float(dt)
+
+    def reset(self, t0: float = 0.0) -> None:
+        """Rewind to ``t0`` — pair with ``engine.reset()`` so a warmed
+        engine (compiled programs kept) can replay a scenario on the
+        identical timeline it saw the first time."""
+        self._t = float(t0)
+
+
+@dataclass(frozen=True)
+class ServiceTimeModel:
+    """Virtual service costs the event loop charges per tick: one
+    admission (prefill) wave, one K-step decode segment, one idle tick
+    (nothing runnable — lets breaching windows roll past).  Only used
+    with a :class:`VirtualClock`; real clocks measure instead."""
+
+    wave_s: float = 0.01
+    segment_s: float = 0.05
+    idle_s: float = 0.005
+
+    def to_json(self) -> Dict[str, float]:
+        return {"wave_s": self.wave_s, "segment_s": self.segment_s,
+                "idle_s": self.idle_s}
+
+
+class _Req:
+    """One logical request's serving state across engine passes."""
+
+    __slots__ = ("a", "cur_prompt", "cur_max_new", "prefix_parts",
+                 "preemptions", "state", "passes")
+
+    def __init__(self, a: Arrival, prompt_ids: np.ndarray):
+        self.a = a
+        self.cur_prompt = prompt_ids          # (1, P) int32, grows on resume
+        self.cur_max_new = a.max_new_tokens
+        self.prefix_parts: List[np.ndarray] = []
+        self.preemptions = 0
+        self.state = "waiting"                # waiting|inflight|shed|done
+        self.passes: List[str] = []           # engine rids, in order
+
+    @property
+    def total_rows(self) -> int:
+        # invariant across preemptions: prompt grows by exactly the
+        # tokens the budget shrank by
+        return int(self.cur_prompt.shape[1]) + self.cur_max_new
+
+    def engine_rid(self) -> str:
+        return (self.a.rid if self.preemptions == 0
+                else f"{self.a.rid}#p{self.preemptions}")
+
+    def record_preemption(self, res: Dict[str, Any]) -> None:
+        tokens = np.asarray(res["tokens"], np.int32)
+        self.prefix_parts.append(tokens)
+        self.cur_prompt = np.concatenate(
+            [self.cur_prompt, tokens[None, :]], axis=1
+        )
+        self.cur_max_new = int(res["remaining"])
+        self.preemptions += 1
+        self.state = "waiting"
+
+
+class ServingFrontend:
+    """Single-threaded serving event loop over one paged decode engine.
+
+    ``engine`` must be freshly constructed (empty queue/slots) and, for
+    deterministic runs, built with a :class:`VirtualClock` — the
+    frontend adopts the engine's clock so both sides share a timeline.
+    ``arrivals`` is the open-loop schedule (:mod:`.loadgen`); more can
+    be injected mid-run via :meth:`submit`.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        arrivals: Sequence[Arrival],
+        policy: Optional[SLOPolicy] = None,
+        *,
+        admission: str = "slo",
+        preemption: bool = True,
+        time_model: Optional[ServiceTimeModel] = None,
+        prompt_seed: int = 0,
+        max_ticks: int = 100_000,
+    ):
+        if admission not in ("fifo", "slo"):
+            raise ValueError(
+                f"admission must be 'fifo' or 'slo', got {admission!r}"
+            )
+        if admission == "slo" and (policy is None or policy.ttft_s is None):
+            raise ValueError(
+                "slo admission needs a policy with a ttft_s target "
+                "(it is the shed/defer control signal)"
+            )
+        self.engine = engine
+        self.policy = policy
+        self.admission = admission
+        self.preemption = preemption and admission == "slo"
+        self.clock = engine._clock
+        self._virtual = hasattr(self.clock, "advance")
+        if time_model is not None and not self._virtual:
+            raise ValueError(
+                "a ServiceTimeModel needs a VirtualClock on the engine"
+            )
+        self.tm = time_model or ServiceTimeModel()
+        self.prompt_seed = prompt_seed
+        self.max_ticks = max_ticks
+        self.vocab_size = int(getattr(engine.config, "vocab_size", 256))
+        self._pending: List[Arrival] = sorted(
+            arrivals, key=lambda a: (a.t, a.rid)
+        )
+        if len({a.rid for a in self._pending}) != len(self._pending):
+            raise ValueError("duplicate rids in arrival schedule")
+        self._backlog: List[_Req] = []
+        self._inflight: Dict[str, _Req] = {}
+        self._reqs: "Dict[str, _Req]" = {}    # logical rid -> state
+        self.results: Dict[str, np.ndarray] = {}
+        self.slo_report: Optional[SLOReport] = None
+        self.t0: Optional[float] = None
+        self.ticks = 0
+
+    # -- external intake ---------------------------------------------------
+    def submit(self, arrival: Arrival) -> None:
+        """Inject an arrival after construction (its ``t`` is still an
+        offset from scenario start)."""
+        if arrival.rid in self._reqs or any(
+            a.rid == arrival.rid for a in self._pending
+        ):
+            raise ValueError(f"duplicate rid {arrival.rid!r}")
+        self._pending.append(arrival)
+        self._pending.sort(key=lambda a: (a.t, a.rid))
+
+    # -- the event loop ----------------------------------------------------
+    def run(self) -> Dict[str, Any]:
+        """Serve the whole arrival schedule to completion; returns
+        :meth:`report`."""
+        if self.t0 is None:
+            self.t0 = self.clock()
+        while self._pending or self._backlog or self._inflight:
+            self.ticks += 1
+            if self.ticks > self.max_ticks:
+                raise RuntimeError(
+                    f"serving loop stalled after {self.max_ticks} ticks: "
+                    f"{len(self._pending)} pending, "
+                    f"{len(self._backlog)} backlogged, "
+                    f"{len(self._inflight)} in flight"
+                )
+            self._tick()
+        return self.report()
+
+    def _tick(self) -> None:
+        now = self.clock()
+        rel = now - self.t0
+        # 1. inject arrivals whose deadline has passed
+        while self._pending and self._pending[0].t <= rel + 1e-9:
+            a = self._pending.pop(0)
+            req = _Req(a, prompt_token_ids(
+                a.rid, a.prompt_len, self.vocab_size, self.prompt_seed
+            ))
+            self._reqs[a.rid] = req
+            if self.admission == "fifo":
+                self._submit_to_engine(req)   # admit-all: engine FIFO queues
+            else:
+                self._backlog.append(req)
+        # 2. admission control (slo mode submits exactly what fits NOW)
+        if self.admission == "slo":
+            waves = self._admit_backlog(now)
+        else:
+            waves = 1 if (self.engine._queue and self.engine.free_slots) else 0
+        # 3. drive the engine one segment; charge virtual service time.
+        #    The wave cost lands BEFORE the engine's admission clock
+        #    reads so prefill has nonzero virtual duration.
+        if self._virtual and waves:
+            self.clock.advance(self.tm.wave_s * waves)
+        engine_busy = (bool(self.engine._queue)
+                       or self.engine.free_slots < self.engine.slots)
+        if engine_busy:
+            seg_before = self.engine.segments_run
+            self.engine.step_segment()
+            if self._virtual and self.engine.segments_run > seg_before:
+                self.clock.advance(self.tm.segment_s)
+        # 4. collect completions (stitch resumed passes)
+        done = [e for e in self._inflight if e in self.engine.results]
+        for erid in done:
+            req = self._inflight.pop(erid)
+            req.state = "done"
+            toks = self.engine.results[erid]
+            if req.prefix_parts:
+                toks = np.concatenate(
+                    [np.asarray(p, np.int32) for p in req.prefix_parts]
+                    + [np.asarray(toks, np.int32)]
+                )
+            self.results[req.a.rid] = np.asarray(toks, np.int32)
+        # 5. idle: nothing ran — move time toward the next arrival (or
+        #    just forward, so a breaching window can roll past a
+        #    deferred backlog)
+        if not engine_busy and not waves:
+            if self._virtual:
+                if self._pending:
+                    gap = (self._pending[0].t - rel)
+                    self.clock.advance(max(gap, self.tm.idle_s))
+                elif self._backlog:
+                    self.clock.advance(self.tm.idle_s)
+            else:
+                wait = 0.001
+                if self._pending:
+                    wait = max(self._pending[0].t - rel, 0.0005)
+                time.sleep(min(wait, 0.05))
+
+    # -- admission / preemption -------------------------------------------
+    def _submit_to_engine(self, req: _Req) -> None:
+        erid = req.engine_rid()
+        self.engine.submit(erid, req.cur_prompt, req.cur_max_new)
+        req.passes.append(erid)
+        req.state = "inflight"
+        self._inflight[erid] = req
+
+    def _admit_backlog(self, now: float) -> int:
+        """SLO-aware admission at one segment boundary; returns the
+        number of prefill waves (distinct prompt lengths) submitted."""
+        if not self._backlog:
+            return 0
+        from ..models.kv_pages import pages_needed
+
+        breaching = self._ttft_breaching(now)
+        target = self.policy.ttft_s
+        keep: List[_Req] = []
+        for req in self._backlog:
+            waited = now - (self.t0 + req.a.t)
+            if (req.a.priority > 0 and not req.passes
+                    and waited > target):
+                # already blew its TTFT budget: zero possible goodput,
+                # so shed instead of spending pages on it
+                req.state = "shed"
+                continue
+            keep.append(req)
+        self._backlog = keep
+        free_slots = self.engine.free_slots
+        free_pages = self.engine.page_occupancy()["free_pages"]
+        order = sorted(
+            self._backlog, key=lambda r: (r.a.priority, r.a.t, r.a.rid)
+        )
+        submitted: List[_Req] = []
+        lens = set()
+        for req in order:
+            if breaching and req.a.priority > 0 and not req.passes:
+                continue  # defer low tier while the TTFT window breaches
+            need = pages_needed(req.total_rows, self.engine.page_size)
+            if free_slots < 1 or need > free_pages:
+                if not (self.preemption and req.a.priority == 0):
+                    continue
+                got = self._try_preempt(req, need, free_slots, free_pages)
+                if got is None:
+                    continue
+                free_slots, free_pages = got
+            self._submit_to_engine(req)
+            submitted.append(req)
+            free_slots -= 1
+            free_pages -= need
+            lens.add(int(req.cur_prompt.shape[1]))
+        for req in submitted:
+            self._backlog.remove(req)
+        return len(lens)
+
+    def _try_preempt(
+        self, req: _Req, need: int, free_slots: int, free_pages: int
+    ):
+        """Evict lower-tier in-flight victims until ``req`` fits;
+        returns the new (free_slots, free_pages) or None when no victim
+        set suffices (then nothing is evicted)."""
+        per_req = self.engine.page_occupancy()["per_request"]
+        victims = [
+            v for v in self._inflight.values()
+            if v.a.priority > req.a.priority and v.passes
+        ]
+        # most recently arrived, lowest tier first: evict the work with
+        # the least sunk queue-wait
+        victims.sort(key=lambda v: (-v.a.priority, -v.a.t, v.a.rid))
+        chosen: List[_Req] = []
+        gs, gp = free_slots, free_pages
+        for v in victims:
+            if gs >= 1 and gp >= need:
+                break
+            chosen.append(v)
+            gs += 1
+            gp += int(per_req.get(v.engine_rid(), 0))
+        if not (gs >= 1 and gp >= need):
+            return None
+        for v in chosen:
+            erid = v.engine_rid()
+            res = self.engine.preempt(erid)
+            del self._inflight[erid]
+            v.record_preemption(res)
+            self._backlog.append(v)
+        return gs, gp
+
+    def _ttft_breaching(self, now: float) -> bool:
+        """The control signal: does a recent window's TTFT percentile
+        breach the policy target?  Evaluated over the serving log
+        (arrival-anchored), not the engine log — in slo mode queueing
+        happens HERE, before the engine ever sees the request."""
+        if self.policy is None or self.policy.ttft_s is None:
+            return False
+        report = evaluate_slo(
+            {"requests": self._rows()}, self.policy, t_end=now
+        )
+        if not report.breaches:
+            return False
+        n = len(report.windows)
+        return any(
+            b["metric"] == "ttft_s" and b["window"] >= n - 2
+            for b in report.breaches
+        )
+
+    # -- the serving log ---------------------------------------------------
+    def _row(self, req: _Req) -> Dict[str, Any]:
+        t_arr = (self.t0 or 0.0) + req.a.t
+        row: Dict[str, Any] = {
+            "rid": str(req.a.rid),
+            "priority": req.a.priority,
+            "prompt_len": req.a.prompt_len,
+            "max_new_tokens": req.a.max_new_tokens,
+            "state": "queued",
+            "t_submit": t_arr,
+            "t_admit": None,
+            "t_first_token": None,
+            "t_retire": None,
+            "n_tokens": 0,
+            "deliveries": [],
+            "preemptions": req.preemptions,
+        }
+        if req.state == "shed":
+            row["state"] = "shed"
+        else:
+            recs = [
+                r for r in (self.engine.reqlog.get(e) for e in req.passes)
+                if r is not None
+            ]
+            if recs:
+                row["t_admit"] = recs[0].t_admit
+                row["t_first_token"] = recs[0].t_first_token
+                deliveries = [d for r in recs for d in r.deliveries]
+                row["deliveries"] = [[t, int(n)] for t, n in deliveries]
+                row["n_tokens"] = int(sum(n for _, n in deliveries))
+                last = recs[-1]
+                if last.state == "retired":
+                    row["state"] = "retired"
+                    row["t_retire"] = last.t_retire
+                elif last.state == "preempted":
+                    row["state"] = "preempted"
+                elif row["t_first_token"] is not None:
+                    row["state"] = "decoding"
+        row["queue_wait_s"] = (
+            row["t_admit"] - t_arr if row["t_admit"] is not None else None
+        )
+        row["ttft_s"] = (
+            row["t_first_token"] - t_arr
+            if row["t_first_token"] is not None else None
+        )
+        row["e2e_s"] = (
+            row["t_retire"] - t_arr
+            if row["t_retire"] is not None else None
+        )
+        n = row["n_tokens"]
+        row["tpot_s"] = (
+            (row["t_retire"] - row["t_first_token"]) / (n - 1)
+            if row["t_retire"] is not None
+            and row["t_first_token"] is not None and n > 1 else None
+        )
+        return row
+
+    def _rows(self) -> List[Dict[str, Any]]:
+        return [self._row(self._reqs[rid]) for rid in self._reqs]
+
+    def request_rows(self) -> List[Dict[str, Any]]:
+        """One row per logical request, ``dls.requests/1``-shaped plus
+        ``priority``/``preemptions`` and the serving-only states
+        ``shed``/``preempted``; ``t_submit`` is the ARRIVAL time."""
+        return self._rows()
+
+    # -- reporting ---------------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        """Serving-leg summary: goodput (tokens/s of SLO-meeting
+        completed requests), arrival-anchored latency percentiles, shed
+        and preemption counts, and the page-leak check.  Idempotent."""
+        t_end = self.clock()
+        rows = self._rows()
+        makespan = max(t_end - (self.t0 if self.t0 is not None else t_end),
+                       1e-12)
+        tokens_total = sum(r["n_tokens"] for r in rows)
+        tokens_good = tokens_total
+        breached = False
+        slo_summary = None
+        if self.policy is not None:
+            rep = evaluate_slo(
+                {"requests": rows}, self.policy, t_end=t_end
+            )
+            self.slo_report = rep
+            tokens_good = rep.tokens_good
+            breached = rep.exceeds()
+            slo_summary = rep.summary()
+        completed = [r for r in rows if r["state"] == "retired"]
+
+        def pct_ms(metric: str) -> Dict[str, Optional[float]]:
+            vals = [
+                float(r[metric]) for r in completed
+                if r.get(metric) is not None
+            ]
+            return {
+                k: (v * 1e3 if v is not None else None)
+                for k, v in _percentiles(vals).items()
+            }
+
+        ttft = pct_ms("ttft_s")
+        qwait = pct_ms("queue_wait_s")
+        tpot = pct_ms("tpot_s")
+        occ = self.engine.page_occupancy()
+        return {
+            "admission": self.admission,
+            "preemption": self.preemption,
+            "n_requests": len(rows),
+            "completed": len(completed),
+            "shed": sum(1 for r in rows if r["state"] == "shed"),
+            "preempted_requests": sum(
+                1 for r in rows if r["preemptions"] > 0
+            ),
+            "preemptions": sum(r["preemptions"] for r in rows),
+            "tokens_total": int(tokens_total),
+            "tokens_good": int(tokens_good),
+            "makespan_s": makespan,
+            "goodput_tok_s": tokens_good / makespan,
+            "throughput_tok_s": tokens_total / makespan,
+            "ttft_p50_ms": ttft["p50"],
+            "ttft_p95_ms": ttft["p95"],
+            "ttft_p99_ms": ttft["p99"],
+            "queue_wait_p50_ms": qwait["p50"],
+            "queue_wait_p95_ms": qwait["p95"],
+            "tpot_p50_ms": tpot["p50"],
+            "pages_leaked": occ["n_pages"] - occ["free_pages"],
+            "breached": breached,
+            "slo": slo_summary,
+            "requests": rows,
+        }
+
+    def digest(self) -> str:
+        """sha256 over the serving log AND every generated token — two
+        same-seed virtual-time runs must match exactly (the serve
+        bench's determinism gate)."""
+        import hashlib
+        import json
+
+        payload = json.dumps(
+            {
+                "requests": self._rows(),
+                "tokens": {
+                    rid: self.results[rid].tolist()
+                    for rid in sorted(self.results)
+                },
+            },
+            sort_keys=True,
+        ).encode("utf-8")
+        return hashlib.sha256(payload).hexdigest()
+
+
+__all__ = [
+    "ServiceTimeModel",
+    "ServingFrontend",
+    "VirtualClock",
+]

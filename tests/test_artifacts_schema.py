@@ -124,6 +124,25 @@ def test_decode_artifact_schema():
                 assert k in slo, (path, k)
 
 
+def test_serve_artifact_schema():
+    d, path = _latest("SERVE")
+    from distributed_llm_scheduler_tpu.eval.serve_bench import (
+        validate_serve_artifact,
+    )
+
+    assert validate_serve_artifact(d) == [], path
+    # the r12 gates: slo+preempt strictly beats fifo admit-all on
+    # goodput at equal offered load, preemption actually fired, no
+    # leaked pages, and the same-seed repeat digested identically
+    fifo = d["legs"]["fifo_admit_all"]
+    slo = d["legs"]["slo_preempt"]
+    assert slo["goodput_tok_s"] > fifo["goodput_tok_s"], path
+    assert slo["preemptions"] >= 1, path
+    assert d["pages_leaked"] == 0, path
+    assert d["deterministic"] is True, path
+    assert fifo["admission"] == "fifo" and slo["admission"] == "slo", path
+
+
 def test_artifact_obs_metrics_blocks_validate():
     """Any artifact leg captured under DLS_TRACE=1 carries an
     ``obs_metrics`` snapshot (added r7); when present it must satisfy the

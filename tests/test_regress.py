@@ -30,6 +30,9 @@ BASE = {
     "mfu_segmented": 0.25,
     "mfu_compiled": 0.28,
     "oracle_ok": True,
+    "serve.goodput_tok_s": 200.0,
+    "serve.ttft_p99_ms": 130.0,
+    "serve.queue_wait_p95_ms": 120.0,
 }
 
 
