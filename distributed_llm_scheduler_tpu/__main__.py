@@ -1461,6 +1461,82 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_soak(args) -> int:
+    """Duration-bounded serving soak with health gating: sustained
+    seeded Poisson load over the paged decode engine (virtual time by
+    default; ``--real-clock`` serves wall-clock arrivals), sampled every
+    ``--sample-every`` seconds into the bounded time-series store and
+    gated by the leak/degradation detector battery (HLT001–HLT006)
+    after ``--warmup`` exclusion.  Exit 0 healthy (schema-valid
+    ``dls.soak/1`` artifact), 1 on a detector breach (the worst
+    series+slope named on stderr; flight rings dumped to --flight-dir),
+    2 on a malformed config or artifact.  ``--inject-leak`` /
+    ``--inject-jit-churn`` are the test/CI fault injectors."""
+    from .serve.soak import SoakConfig, run_soak, validate_soak_artifact
+
+    try:
+        cfg = SoakConfig(
+            duration_s=args.duration, sample_every_s=args.sample_every,
+            warmup_s=args.warmup, rate_rps=args.rate, seed=args.seed,
+            admission=args.admission, ttft_s=args.ttft,
+            window_s=args.window, percentile=args.percentile,
+            capacity=args.capacity, real_clock=args.real_clock,
+        )
+        cfg.validate()
+        if args.inject_leak is not None and args.inject_leak < 1:
+            raise ValueError(
+                f"--inject-leak must be >= 1, got {args.inject_leak}"
+            )
+    except ValueError as e:
+        print(f"soak: {e}", file=sys.stderr)
+        return 2
+    art = run_soak(
+        cfg, flight_dir=args.flight_dir,
+        inject_leak_every=args.inject_leak,
+        inject_churn=args.inject_jit_churn,
+    )
+    errs = validate_soak_artifact(art)
+    if errs:
+        for e in errs[:10]:
+            print(f"soak: artifact invalid: {e}", file=sys.stderr)
+        return 2
+    if art["flight_dumps"]:
+        from .obs.export import validate_trace
+
+        for rec in art["flight_dumps"]:
+            rec["trace_valid"] = validate_trace(rec["trace"]) == []
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(art, f, indent=1, sort_keys=True)
+        print(f"soak: artifact -> {args.out}", file=sys.stderr)
+    print(json.dumps(
+        {k: v for k, v in art.items() if k != "timeseries"},
+        indent=1, sort_keys=True,
+    ))
+    if art["verdict"] == "breach":
+        worst = max(
+            (f for f in art["health"]["findings"]
+             if f["severity"] == "error" and f["slope"] is not None),
+            key=lambda f: abs(f["slope"]) / f["threshold"],
+        )
+        print(
+            f"soak: {worst['code']} {worst['detector']}: "
+            f"{worst['series']} slope {worst['slope']:+.6g}/s exceeds "
+            f"{worst['threshold']:g}/s past warmup "
+            f"({art['config']['warmup_s']:g}s)", file=sys.stderr,
+        )
+        return 1
+    steady = art["steady_state"]
+    print(
+        f"soak: healthy — {art['soak.goodput_tok_s']:.1f} tok/s steady "
+        f"state over {steady['span_s']:.2f}s "
+        f"({art['clock']} clock, {art['serving']['completed']} completed, "
+        f"{art['serving']['pages_leaked']} pages leaked)",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def cmd_doctor(args) -> int:
     """Run doctor: measured critical-path attribution (+ cost-model
     drift when the run is live).  ``--trace`` diagnoses an exported
@@ -1486,6 +1562,8 @@ def cmd_doctor(args) -> int:
         return _cmd_doctor_memory(args)
     if getattr(args, "slo", False):
         return _cmd_doctor_slo(args)
+    if getattr(args, "soak", None):
+        return _cmd_doctor_soak(args)
     if args.trace:
         try:
             att = attribute_trace(args.trace)
@@ -1631,21 +1709,98 @@ def _cmd_doctor_slo(args) -> int:
     return 0
 
 
+def _cmd_doctor_soak(args) -> int:
+    """The soak half of the doctor (``doctor --soak SOAK_JSON``):
+    re-gate a saved ``dls.soak/1`` artifact offline by rebuilding the
+    time-series store from its embedded snapshot and re-running the
+    default detector battery.  Exit 2 malformed, 1 on breach, 0
+    healthy."""
+    from .obs.health import report_from_soak_artifact
+    from .serve.soak import load_soak_artifact
+
+    try:
+        art = load_soak_artifact(args.soak)
+        report = report_from_soak_artifact(art)
+    except (OSError, ValueError) as e:
+        print(f"doctor --soak: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(
+        {
+            "soak": {
+                "clock": art["clock"],
+                "verdict_recorded": art["verdict"],
+                "steady_state": art["steady_state"],
+                "injection": art.get("injection", {}),
+            },
+            "health": report.to_json(),
+        },
+        indent=1,
+    ))
+    if report.exceeds():
+        w = report.worst_breach()
+        print(
+            f"doctor: {w.code} {w.detector}: {w.series} slope "
+            f"{w.slope:+.6g}/s exceeds {w.threshold:g}/s past warmup "
+            f"({report.warmup_s:g}s)", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_metrics_diff(args) -> int:
     """``metrics diff A B``: counter/gauge deltas and histogram quantile
-    shifts between two ``dls.metrics/1`` snapshots.  Exit 2 on an
-    unreadable file or schema mismatch."""
+    shifts between two ``dls.metrics/1`` snapshots — or, with
+    ``--at I --vs J``, between two sample indices of ONE
+    ``dls.timeseries/1`` file (a ``dls.soak/1`` artifact's embedded
+    series also works), so start-of-soak vs end-of-soak diffs need no
+    hand-edited JSON.  Exit 2 on an unreadable file, schema mismatch, or
+    an index no series can satisfy."""
     from .obs.metrics import diff_snapshots
 
-    snaps = []
-    for path in (args.snapshot_a, args.snapshot_b):
-        try:
-            with open(path) as f:
-                snaps.append(json.load(f))
-        except (OSError, ValueError) as e:
-            print(f"metrics diff: unreadable snapshot {path}: {e}",
+    if args.at is not None or args.vs is not None:
+        if args.at is None or args.vs is None:
+            print("metrics diff: --at and --vs go together",
                   file=sys.stderr)
             return 2
+        if args.snapshot_b is not None:
+            print("metrics diff: --at/--vs index ONE timeseries file, "
+                  "not two snapshots", file=sys.stderr)
+            return 2
+        from .obs.timeseries import snapshot_at
+
+        try:
+            with open(args.snapshot_a) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"metrics diff: unreadable timeseries "
+                  f"{args.snapshot_a}: {e}", file=sys.stderr)
+            return 2
+        if isinstance(obj, dict) and "timeseries" in obj:
+            obj = obj["timeseries"]     # a dls.soak/1 artifact
+        try:
+            snaps = [snapshot_at(obj, args.at), snapshot_at(obj, args.vs)]
+        except ValueError as e:
+            print(f"metrics diff: {e}", file=sys.stderr)
+            return 2
+        if not snaps[0]["gauges"] or not snaps[1]["gauges"]:
+            which = args.at if not snaps[0]["gauges"] else args.vs
+            print(f"metrics diff: no series holds sample index {which}",
+                  file=sys.stderr)
+            return 2
+    else:
+        if args.snapshot_b is None:
+            print("metrics diff: need two snapshot files (or --at/--vs "
+                  "over one timeseries)", file=sys.stderr)
+            return 2
+        snaps = []
+        for path in (args.snapshot_a, args.snapshot_b):
+            try:
+                with open(path) as f:
+                    snaps.append(json.load(f))
+            except (OSError, ValueError) as e:
+                print(f"metrics diff: unreadable snapshot {path}: {e}",
+                      file=sys.stderr)
+                return 2
     try:
         diff = diff_snapshots(*snaps)
     except ValueError as e:
@@ -1920,10 +2075,19 @@ def main(argv=None) -> int:
         "diff",
         help="diff two dls.metrics/1 snapshot files: counter/gauge "
              "deltas + histogram p50/p95 shifts (exit 2 on schema "
-             "mismatch)",
+             "mismatch); or with --at/--vs, diff two sample indices of "
+             "one dls.timeseries/1 file (dls.soak/1 artifacts work too)",
     )
-    pd.add_argument("snapshot_a", help="before snapshot JSON")
-    pd.add_argument("snapshot_b", help="after snapshot JSON")
+    pd.add_argument("snapshot_a",
+                    help="before snapshot JSON (with --at/--vs: the "
+                         "timeseries or soak-artifact JSON)")
+    pd.add_argument("snapshot_b", nargs="?", default=None,
+                    help="after snapshot JSON (omit with --at/--vs)")
+    pd.add_argument("--at", type=int, default=None, metavar="INDEX",
+                    help="'before' sample index into each series "
+                         "(Python-style; negatives count from the end)")
+    pd.add_argument("--vs", type=int, default=None, metavar="INDEX",
+                    help="'after' sample index into each series")
     pd.set_defaults(fn=cmd_metrics_diff)
 
     p = sub.add_parser(
@@ -2004,6 +2168,58 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
+        "soak",
+        help="duration-bounded serving soak with bounded time-series "
+             "telemetry and trend health gating (exit 1 on "
+             "leak/degradation breach, 2 on malformed input)",
+    )
+    _add_common(p)
+    p.add_argument("--duration", type=float, default=4.0, metavar="SECONDS",
+                   help="soak length in clock seconds (default 4.0)")
+    p.add_argument("--sample-every", type=float, default=0.1,
+                   dest="sample_every", metavar="SECONDS",
+                   help="telemetry sampling cadence (default 0.1)")
+    p.add_argument("--warmup", type=float, default=1.0, metavar="SECONDS",
+                   help="prefix excluded from every trend (default 1.0)")
+    p.add_argument("--rate", type=float, default=12.0, metavar="RPS",
+                   help="sustained offered load for the seeded Poisson "
+                        "generator (default 12.0 req/s)")
+    p.add_argument("--admission", default="slo", choices=("slo", "fifo"),
+                   help="front-end admission policy (default slo)")
+    p.add_argument("--ttft", type=float, default=0.3, metavar="SECONDS",
+                   help="admission TTFT target at --percentile "
+                        "(default 0.3)")
+    p.add_argument("--window", type=float, default=0.2, metavar="SECONDS",
+                   help="admission sliding-window size (default 0.2)")
+    p.add_argument("--percentile", default="p95",
+                   choices=("p50", "p95", "p99"),
+                   help="which per-window quantile gates admission "
+                        "(default p95)")
+    p.add_argument("--capacity", type=int, default=512,
+                   help="per-series ring capacity; overflow decimates "
+                        "2:1 (default 512)")
+    p.add_argument("--real-clock", action="store_true", dest="real_clock",
+                   help="run against the wall clock (monotonic time, "
+                        "real idle sleeps) instead of the virtual clock")
+    p.add_argument("--flight-dir", default=None, dest="flight_dir",
+                   metavar="DIR",
+                   help="on the first health breach, dump the flight-"
+                        "recorder rings (Perfetto trace + request log) "
+                        "here while the anomaly is still in them")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="write the full dls.soak/1 artifact (including "
+                        "the timeseries snapshot) here")
+    p.add_argument("--inject-leak", type=int, default=None,
+                   dest="inject_leak", metavar="N",
+                   help="testing: withhold one page from every Nth "
+                        "free() — must trip HLT001")
+    p.add_argument("--inject-jit-churn", action="store_true",
+                   dest="inject_jit_churn",
+                   help="testing: plant a fresh prefill compile-cache "
+                        "entry every segment — must trip HLT003")
+    p.set_defaults(fn=cmd_soak)
+
+    p = sub.add_parser(
         "doctor",
         help="explain a run: measured critical-path attribution "
              "(compute/transfer/dispatch/idle) + cost-model drift",
@@ -2045,6 +2261,11 @@ def main(argv=None) -> int:
     p.add_argument("--slo-window", type=float, default=1.0,
                    dest="slo_window", metavar="SECONDS",
                    help="with --slo: window size (default 1.0)")
+    p.add_argument("--soak", default=None, metavar="SOAK_JSON",
+                   help="soak doctor: re-gate a saved dls.soak/1 "
+                        "artifact offline — rebuild its timeseries and "
+                        "re-run the leak/degradation detector battery "
+                        "(exit 1 on breach, 2 malformed)")
     p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(

@@ -24,7 +24,6 @@ Reference anchor: the scheduler-owns-inference story is this repo's own
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -348,6 +347,7 @@ class PagedDecodeEngine:
             ambient_flight,
             ambient_metrics,
             ambient_tracer,
+            resolve_clock,
         )
 
         self.config = config
@@ -397,9 +397,9 @@ class PagedDecodeEngine:
             else (ambient_metrics() or MetricsRegistry())
         )
         # injectable clock (tests script TTFT/TPOT deterministically);
-        # reads happen between dispatches, so the default perf_counter
-        # shares the host tracer's timebase
-        self._clock = clock if clock is not None else time.perf_counter
+        # reads happen between dispatches, so the shared obs default
+        # keeps the engine on the host tracer's timebase
+        self._clock = resolve_clock(clock)
         self._submit_t: Dict[Any, float] = {}     # rid -> submit() time
         self._first_tok_t: Dict[Any, float] = {}  # rid -> first-token time
         # flight recorder (explicit, or ambient under DLS_FLIGHT): its
@@ -523,6 +523,17 @@ class PagedDecodeEngine:
         ).set(used)
         if self.tracer is not None:
             self.tracer.counter("decode.page_pool_occupancy_pages", used)
+
+    def _emit_jit_cache_size(self) -> None:
+        """Sample the prefill compile-class cache size per tick — the
+        soak doctor's recompile-churn series: a healthy engine closes
+        its compile classes during warmup and this gauge goes flat."""
+        entries = len(self._prefill_cache)
+        self.metrics.gauge(
+            "decode.jit_cache_entries", unit="entries"
+        ).set(entries)
+        if self.tracer is not None:
+            self.tracer.counter("decode.jit_cache_entries", entries)
 
     def summary(self) -> Dict[str, Any]:
         """Engine-state snapshot: slot/queue/pool headroom at this
@@ -854,6 +865,7 @@ class PagedDecodeEngine:
         self.metrics.counter("decode.tokens_delivered").inc(delivered)
         self._emit_pool_occupancy()
         self._emit_queue_depth()
+        self._emit_jit_cache_size()
         return delivered
 
     def run(self) -> Dict[Any, Any]:

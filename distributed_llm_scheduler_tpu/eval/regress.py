@@ -41,6 +41,14 @@ LOWER_BETTER = (
     "fence_rtt_ms",
     "serve.ttft_p99_ms",
     "serve.queue_wait_p95_ms",
+    # soak health slopes (dls.soak/1 artifact): clamped to >= 0, a
+    # healthy run sits at or near 0 — any growth is a leak/degradation
+    "soak.page_leak_slope_pages_s",
+    "soak.hbm_slope_bytes_s",
+    "soak.jit_cache_slope_entries_s",
+    "soak.ttft_p95_slope_s_per_s",
+    "soak.queue_wait_p95_slope_s_per_s",
+    "soak.throughput_decay_tok_s2",
 )
 
 # lower-is-better metric FAMILIES, matched by prefix: per-device peak
@@ -63,6 +71,11 @@ METRIC_DEFAULT_TOLERANCES = {
     "serve.goodput_tok_s": 0.0,
     "serve.ttft_p99_ms": 0.0,
     "serve.queue_wait_p95_ms": 0.0,
+    # soak slopes share the serve bench's VirtualClock determinism: the
+    # timestamps and token counts behind every Theil-Sen fit are pure
+    # functions of the seed, so exact match is the right band even
+    # though healthy hbm/jit/latency slopes are nonzero
+    "soak": 0.0,
 }
 HIGHER_BETTER = (
     "vs_baseline",
@@ -70,6 +83,7 @@ HIGHER_BETTER = (
     "mfu_segmented",
     "mfu_compiled",
     "serve.goodput_tok_s",
+    "soak.goodput_tok_s",
 )
 BOOL_METRICS = ("oracle_ok",)
 

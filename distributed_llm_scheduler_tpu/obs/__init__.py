@@ -21,7 +21,15 @@ subsystems (planned dispatch, segment fusion, paged decode):
 * :mod:`.slo` — sliding-window SLO accounting (windowed p50/p95/p99,
   goodput vs raw throughput, breach gate) over the request log;
 * :mod:`.flight` — always-on bounded ring-buffer flight recorder that
-  dumps trace + request log on SLO breach / near-OOM / straggler.
+  dumps trace + request log on SLO breach / near-OOM / straggler /
+  soak health breach;
+* :mod:`.clockutil` — the ONE injected-or-default timebase decision
+  every module above routes its ``clock`` argument through;
+* :mod:`.timeseries` — bounded-memory time series (fixed capacity,
+  deterministic 2:1 decimation) with the ``dls.timeseries/1`` schema,
+  Theil–Sen trend estimation, and the soak sampler;
+* :mod:`.health` — the soak doctor's trend gate: leak/degradation
+  detectors (HLT001–HLT006) over time series, ``exceeds``-style report.
 
 Everything is opt-in.  Two ways to turn it on:
 
@@ -46,8 +54,17 @@ import os
 from typing import Optional
 
 from .attribution import Attribution, attribute_run, attribute_trace
+from .clockutil import Clock, default_clock, resolve_clock
 from .drift import DriftReport, compute_drift
 from .flight import FlightRecorder, RingTracer, TeeTracer
+from .health import (
+    Detector,
+    HealthFinding,
+    HealthMonitor,
+    HealthReport,
+    default_detectors,
+    report_from_soak_artifact,
+)
 from .memdrift import MemDriftReport, compute_mem_drift
 from .memprof import MemoryProfiler
 from .metrics import MetricsRegistry
@@ -58,6 +75,16 @@ from .reqlog import (
     validate_request_log,
 )
 from .slo import SLOPolicy, SLOReport, evaluate_slo
+from .timeseries import (
+    Series,
+    SoakSampler,
+    TimeSeriesStore,
+    load_timeseries,
+    save_timeseries,
+    snapshot_at,
+    theil_sen_slope,
+    validate_timeseries,
+)
 from .trace import HOST_TRACK, Tracer
 
 _TRUTHY = ("1", "true", "yes", "on")
@@ -122,9 +149,14 @@ def reset_ambient() -> None:
 
 __all__ = [
     "Attribution",
+    "Clock",
+    "Detector",
     "DriftReport",
     "FlightRecorder",
     "HOST_TRACK",
+    "HealthFinding",
+    "HealthMonitor",
+    "HealthReport",
     "MemDriftReport",
     "MemoryProfiler",
     "MetricsRegistry",
@@ -133,7 +165,10 @@ __all__ = [
     "RingTracer",
     "SLOPolicy",
     "SLOReport",
+    "Series",
+    "SoakSampler",
     "TeeTracer",
+    "TimeSeriesStore",
     "Tracer",
     "ambient_flight",
     "ambient_metrics",
@@ -142,10 +177,19 @@ __all__ = [
     "attribute_trace",
     "compute_drift",
     "compute_mem_drift",
+    "default_clock",
+    "default_detectors",
     "evaluate_slo",
     "flight_enabled",
+    "load_timeseries",
+    "report_from_soak_artifact",
     "reset_ambient",
+    "resolve_clock",
+    "save_timeseries",
+    "snapshot_at",
     "summarize_request_log",
+    "theil_sen_slope",
     "trace_enabled",
     "validate_request_log",
+    "validate_timeseries",
 ]

@@ -1,0 +1,48 @@
+"""One obs timebase.
+
+Every obs module that timestamps events — :mod:`.trace`,
+:mod:`.reqlog`, :mod:`.flight`, :mod:`.memprof`, the decode engine's
+lifecycle seams, and the soak layer's :mod:`.timeseries` — accepts an
+injectable ``clock`` and needs a default when none is given.  Before
+this module each of them independently spelled the fallback
+``clock or time.perf_counter``; four independent defaults are four
+chances for a refactor to silently fork the timebase, and a soak run
+whose series, request log, and flight ring disagree on "now" cannot be
+correlated.
+
+``resolve_clock`` is now the ONE place the injected-or-None decision is
+made: pass an explicit clock (a real monotonic source or a scripted
+:class:`~..serve.frontend.VirtualClock`) and every sink downstream of
+it shares that timeline; pass ``None`` and everything falls back to the
+SAME ``time.perf_counter``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+#: the type every obs clock satisfies: a zero-arg monotonic read
+Clock = Callable[[], float]
+
+
+def default_clock() -> Clock:
+    """The process-wide fallback timebase: ``time.perf_counter`` —
+    monotonic, high resolution, and shared with the host-side tracer
+    spans so cross-module timestamps stay comparable."""
+    return time.perf_counter
+
+
+def resolve_clock(clock: Optional[Clock]) -> Clock:
+    """Turn an injected-or-None clock into a callable timebase.
+
+    Every obs constructor routes its ``clock`` argument through here so
+    a run that injects one clock (virtual or real) gets a single
+    timeline across trace, request log, flight ring, memory profile,
+    and time series — and a run that injects nothing gets one shared
+    default rather than four independently-chosen ones.
+    """
+    return clock if clock is not None else default_clock()
+
+
+__all__ = ["Clock", "default_clock", "resolve_clock"]

@@ -12,7 +12,9 @@ log only when a trigger fires:
 * an SLO breach (:meth:`~.slo.SLOReport.exceeds`),
 * near-OOM headroom (a :class:`~.memdrift.MemDriftReport` whose
   headroom block carries ``warn`` entries),
-* a straggler device (:class:`~.attribution.Attribution.stragglers`).
+* a straggler device (:class:`~.attribution.Attribution.stragglers`),
+* a soak health breach (:meth:`~.health.HealthReport.exceeds` — a
+  leak/degradation trend crossing its detector threshold mid-soak).
 
 Memory is O(capacity) regardless of run length — ``collections.deque``
 with ``maxlen`` evicts the oldest event on each append — and the
@@ -30,11 +32,11 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
+from .clockutil import resolve_clock
 from .reqlog import RequestLog
 from .trace import HOST_TRACK, Tracer
 
@@ -163,7 +165,7 @@ class FlightRecorder:
         request_capacity: int = 256,
         clock: Optional[Callable[[], float]] = None,
     ):
-        self.clock: Callable[[], float] = clock or time.perf_counter
+        self.clock: Callable[[], float] = resolve_clock(clock)
         self.tracer = RingTracer(capacity, clock=self.clock)
         self.reqlog = RequestLog(clock=self.clock,
                                  capacity=request_capacity)
@@ -175,6 +177,7 @@ class FlightRecorder:
         slo_report: Any = None,
         memdrift: Any = None,
         attribution: Any = None,
+        health: Any = None,
     ) -> List[str]:
         """Evaluate the trigger conditions; returns human-readable
         reasons (empty list == nothing to dump)."""
@@ -198,6 +201,13 @@ class FlightRecorder:
         if attribution is not None:
             for dev in getattr(attribution, "stragglers", []) or []:
                 reasons.append(f"straggler: {dev}")
+        if health is not None and health.exceeds():
+            for f in health.breaches():
+                slope = "n/a" if f.slope is None else f"{f.slope:+.6g}"
+                reasons.append(
+                    f"health_breach: {f.code} {f.detector} "
+                    f"{f.series} slope={slope}/s > {f.threshold:g}/s"
+                )
         return reasons
 
     # -- dumping -----------------------------------------------------------
@@ -233,10 +243,11 @@ class FlightRecorder:
         slo_report: Any = None,
         memdrift: Any = None,
         attribution: Any = None,
+        health: Any = None,
     ) -> Optional[Dict[str, Any]]:
         """Dump iff a trigger fires; returns the dump record or None."""
         reasons = self.triggers(slo_report=slo_report, memdrift=memdrift,
-                                attribution=attribution)
+                                attribution=attribution, health=health)
         if not reasons:
             return None
         return self.dump(out_dir, reasons)

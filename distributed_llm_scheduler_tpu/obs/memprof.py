@@ -47,8 +47,9 @@ the span rows at ui.perfetto.dev.
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .clockutil import resolve_clock
 
 #: watermark attribution buckets, in render order
 BUCKETS = ("params", "activations", "kv_pages", "transfers")
@@ -76,7 +77,7 @@ class MemoryProfiler:
         clock: Optional[Callable[[], float]] = None,
         tracer: Any = None,
     ):
-        self.clock: Callable[[], float] = clock or time.perf_counter
+        self.clock: Callable[[], float] = resolve_clock(clock)
         self.tracer = tracer
         self.events: List[Dict[str, Any]] = []
         # device -> {label: (bytes, bucket)} — the live set

@@ -43,9 +43,10 @@ and summarized like ``dls.metrics/1``:
 
 from __future__ import annotations
 
-import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .clockutil import resolve_clock
 
 SCHEMA = "dls.requests/1"
 
@@ -160,7 +161,7 @@ class RequestLog:
         # the clock is only used by callers that want ``log.now()``
         # convenience (the CLI's live mode); the engine passes explicit
         # timestamps everywhere
-        self.clock: Callable[[], float] = clock or time.perf_counter
+        self.clock: Callable[[], float] = resolve_clock(clock)
         self.capacity = capacity
         self._records: "OrderedDict[Any, RequestRecord]" = OrderedDict()
         self.evicted = 0

@@ -34,9 +34,10 @@ Track names are free-form strings; by convention ``"host"``
 
 from __future__ import annotations
 
-import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from .clockutil import resolve_clock
 
 HOST_TRACK = "host"
 
@@ -71,7 +72,7 @@ class Tracer:
     """
 
     def __init__(self, clock: Optional[Callable[[], float]] = None):
-        self.clock: Callable[[], float] = clock or time.perf_counter
+        self.clock: Callable[[], float] = resolve_clock(clock)
         self.events: List[Dict[str, Any]] = []
         self._open: List[Dict[str, Any]] = []
         self._flow_id = 0

@@ -1,9 +1,18 @@
-"""Online serving layer: open-loop load generation (:mod:`.loadgen`)
-and the event-loop front-end with SLO-aware admission and priority
+"""Online serving layer: open-loop load generation (:mod:`.loadgen`),
+the event-loop front-end with SLO-aware admission and priority
 preemption (:mod:`.frontend`) over the paged continuous-batching
-decode engine.  See ``docs/SERVING.md``."""
+decode engine, and the duration-bounded soak harness with health
+gating (:mod:`.soak`).  See ``docs/SERVING.md``."""
 
 from .frontend import ServiceTimeModel, ServingFrontend, VirtualClock
+from .soak import (
+    SoakConfig,
+    inject_jit_churn,
+    inject_page_leak,
+    load_soak_artifact,
+    run_soak,
+    validate_soak_artifact,
+)
 from .loadgen import (
     Arrival,
     TRACE_SCHEMA,
@@ -28,5 +37,11 @@ __all__ = [
     "prompt_token_ids",
     "save_trace",
     "schedule_digest",
+    "SoakConfig",
+    "inject_jit_churn",
+    "inject_page_leak",
+    "load_soak_artifact",
+    "run_soak",
+    "validate_soak_artifact",
     "validate_trace_obj",
 ]

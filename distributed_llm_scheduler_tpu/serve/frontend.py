@@ -154,6 +154,7 @@ class ServingFrontend:
         time_model: Optional[ServiceTimeModel] = None,
         prompt_seed: int = 0,
         max_ticks: int = 100_000,
+        sleep: Optional[Any] = None,
     ):
         if admission not in ("fifo", "slo"):
             raise ValueError(
@@ -175,6 +176,10 @@ class ServingFrontend:
                 "a ServiceTimeModel needs a VirtualClock on the engine"
             )
         self.tm = time_model or ServiceTimeModel()
+        # injectable idle sleep (real-clock mode only): tests script a
+        # fake clock + recording sleep to cover the wall-clock path
+        # without spending wall time
+        self._sleep = sleep if sleep is not None else time.sleep
         self.prompt_seed = prompt_seed
         self.max_ticks = max_ticks
         self.vocab_size = int(getattr(engine.config, "vocab_size", 256))
@@ -203,12 +208,33 @@ class ServingFrontend:
         self._pending.sort(key=lambda a: (a.t, a.rid))
 
     # -- the event loop ----------------------------------------------------
-    def run(self) -> Dict[str, Any]:
-        """Serve the whole arrival schedule to completion; returns
-        :meth:`report`."""
+    def run(
+        self,
+        *,
+        deadline: Optional[float] = None,
+        on_tick: Optional[Any] = None,
+    ) -> Dict[str, Any]:
+        """Serve the arrival schedule to completion; returns
+        :meth:`report`.
+
+        ``deadline`` bounds the run in seconds since ``t0`` (virtual or
+        wall, whichever clock the engine carries) — the soak harness's
+        ``--duration``.  At the deadline, not-yet-injected arrivals are
+        dropped and the backlog is shed (they can produce no goodput in
+        the remaining window), then in-flight work drains normally so
+        page accounting ends clean.  ``on_tick(frontend)`` runs after
+        every tick — the soak sampler's hook; it must only READ (a
+        callback that advances the clock or mutates the engine would
+        fork the deterministic timeline).
+        """
         if self.t0 is None:
             self.t0 = self.clock()
         while self._pending or self._backlog or self._inflight:
+            if (deadline is not None
+                    and self.clock() - self.t0 >= deadline):
+                self._shed_remaining()
+                if not self._inflight:
+                    break
             self.ticks += 1
             if self.ticks > self.max_ticks:
                 raise RuntimeError(
@@ -218,7 +244,17 @@ class ServingFrontend:
                     f"{len(self._inflight)} in flight"
                 )
             self._tick()
+            if on_tick is not None:
+                on_tick(self)
         return self.report()
+
+    def _shed_remaining(self) -> None:
+        """Deadline passed: drop arrivals that never happened and shed
+        the backlog; in-flight work keeps draining."""
+        self._pending.clear()
+        for req in self._backlog:
+            req.state = "shed"
+        self._backlog.clear()
 
     def _tick(self) -> None:
         now = self.clock()
@@ -274,10 +310,14 @@ class ServingFrontend:
                 elif self._backlog:
                     self.clock.advance(self.tm.idle_s)
             else:
+                # real clock: actually sleep until the next arrival's
+                # deadline (floor keeps the loop from busy-spinning on
+                # an imminent arrival; cap keeps mid-run submit()s and
+                # soak deadlines responsive within 50 ms)
                 wait = 0.001
                 if self._pending:
                     wait = max(self._pending[0].t - rel, 0.0005)
-                time.sleep(min(wait, 0.05))
+                self._sleep(min(wait, 0.05))
 
     # -- admission / preemption -------------------------------------------
     def _submit_to_engine(self, req: _Req) -> None:

@@ -143,6 +143,34 @@ def test_serve_artifact_schema():
     assert fifo["admission"] == "fifo" and slo["admission"] == "slo", path
 
 
+def test_soak_artifact_schema():
+    d, path = _latest("SOAK")
+    from distributed_llm_scheduler_tpu.obs.timeseries import (
+        validate_timeseries,
+    )
+    from distributed_llm_scheduler_tpu.serve.soak import (
+        SLOPE_METRICS,
+        validate_soak_artifact,
+    )
+
+    assert validate_soak_artifact(d) == [], path
+    # the r13 gates: the committed baseline is a HEALTHY virtual-clock
+    # soak (CI regresses fresh runs against it at exact match), with no
+    # orphaned pages and every sampled series within its ring capacity
+    assert d["verdict"] == "healthy", path
+    assert d["clock"] == "virtual", path
+    assert d["serving"]["pages_leaked"] == 0, path
+    assert d["soak.page_leak_slope_pages_s"] == 0.0, path
+    for m in SLOPE_METRICS.values():
+        assert isinstance(d[m], (int, float)), (path, m)
+    ts = d["timeseries"]
+    assert validate_timeseries(ts) == [], path
+    for name, row in ts["series"].items():
+        assert len(row["points"]) <= ts["capacity"], (path, name)
+        stamps = [t for t, _ in row["points"]]
+        assert stamps == sorted(stamps), (path, name)
+
+
 def test_artifact_obs_metrics_blocks_validate():
     """Any artifact leg captured under DLS_TRACE=1 carries an
     ``obs_metrics`` snapshot (added r7); when present it must satisfy the

@@ -1,0 +1,332 @@
+"""Soak doctor tests: Theil–Sen golden exactness, bounded-series
+decimation determinism (the retained set is a pure function of the
+offered count), timeseries schema round-trips, detector true/false
+positives via the fault injectors, instrumented-vs-bare bit identity,
+the ``soak`` CLI exit-code contract (0 healthy / 1 breach / 2
+malformed) with ``doctor --soak`` offline re-gating and ``metrics diff
+--at/--vs``, and a short REAL-clock smoke (monotone wall timestamps,
+zero leaked pages)."""
+
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_llm_scheduler_tpu.obs.health import (  # noqa: E402
+    Detector,
+    HealthMonitor,
+    default_detectors,
+    report_from_soak_artifact,
+)
+from distributed_llm_scheduler_tpu.obs.timeseries import (  # noqa: E402
+    Series,
+    TimeSeriesStore,
+    load_timeseries,
+    save_timeseries,
+    snapshot_at,
+    theil_sen_slope,
+    validate_timeseries,
+)
+from distributed_llm_scheduler_tpu.serve.soak import (  # noqa: E402
+    SLOPE_METRICS,
+    SoakConfig,
+    run_soak,
+    validate_soak_artifact,
+)
+
+
+# -- shared soak runs (each costs a few wall seconds; run once) ------------
+@pytest.fixture(scope="module")
+def healthy_art():
+    return run_soak(SoakConfig())
+
+
+@pytest.fixture(scope="module")
+def leak_art(tmp_path_factory):
+    fdir = tmp_path_factory.mktemp("flight")
+    return run_soak(SoakConfig(), flight_dir=str(fdir),
+                    inject_leak_every=2)
+
+
+# -- Theil-Sen -------------------------------------------------------------
+def test_theil_sen_golden_exact():
+    # v = 2t exactly -> every pairwise slope is exactly 2.0
+    ts = [0.1 * i for i in range(20)]
+    vs = [2.0 * t for t in ts]
+    assert theil_sen_slope(ts, vs) == 2.0
+    # constant series -> slope exactly 0.0
+    assert theil_sen_slope(ts, [5.0] * 20) == 0.0
+
+
+def test_theil_sen_outlier_robust():
+    # one wild spike cannot move the median slope off the trend
+    ts = [float(i) for i in range(21)]
+    vs = [3.0 * t for t in ts]
+    vs[10] = 1e6
+    assert abs(theil_sen_slope(ts, vs) - 3.0) < 1e-9
+
+
+def test_theil_sen_degenerate():
+    assert theil_sen_slope([], []) is None
+    assert theil_sen_slope([1.0], [2.0]) is None
+    # two points, same timestamp: no judgeable pair
+    assert theil_sen_slope([1.0, 1.0], [2.0, 3.0]) is None
+    with pytest.raises(ValueError):
+        theil_sen_slope([1.0, 2.0], [1.0])
+
+
+# -- bounded series + decimation -------------------------------------------
+def test_series_bounded_and_decimation_deterministic():
+    """Offer >= 10x capacity; the retained set must be exactly
+    {i : i % stride == 0} — a pure function of the offered count, never
+    of when the overflow fired — and never exceed capacity."""
+    cap, n = 16, 200  # 12.5x capacity
+    s = Series("x", capacity=cap)
+    for i in range(n):
+        s.append(float(i), float(i))
+    assert len(s) <= cap
+    expected = [float(i) for i in range(n) if i % s.stride == 0]
+    assert s.vs == expected
+    assert s.ts == expected
+    assert s.offered == n
+    # the same offered count through a different capacity still retains
+    # a strided prefix-closed set
+    s2 = Series("y", capacity=8)
+    for i in range(n):
+        s2.append(float(i), float(i))
+    assert s2.vs == [float(i) for i in range(n) if i % s2.stride == 0]
+    # decimation preserves an exact linear trend exactly
+    assert s.slope() == 1.0
+
+
+def test_series_rejects_nonmonotone_and_tiny_capacity():
+    s = Series("x", capacity=4)
+    s.append(1.0, 0.0)
+    with pytest.raises(ValueError):
+        s.append(0.5, 0.0)
+    with pytest.raises(ValueError):
+        Series("x", capacity=1)
+
+
+def test_series_window_excludes_warmup():
+    s = Series("x", capacity=64)
+    for i in range(10):
+        s.append(float(i), 100.0 if i < 5 else float(i))
+    ts, vs = s.window(since_t=5.0)
+    assert ts == [5.0, 6.0, 7.0, 8.0, 9.0]
+    assert s.slope(since_t=5.0) == 1.0
+
+
+# -- timeseries store + schema ---------------------------------------------
+def test_store_roundtrip_and_validation(tmp_path):
+    store = TimeSeriesStore(capacity=32)
+    for i in range(10):
+        store.record("a.b", float(i), t=0.1 * i, unit="pages")
+        store.record("c.d", 2.0 * i, t=0.1 * i)
+    snap = store.snapshot()
+    assert validate_timeseries(snap) == []
+    path = str(tmp_path / "ts.json")
+    save_timeseries(store, path)
+    loaded = load_timeseries(path)
+    assert loaded == json.loads(json.dumps(snap))
+    assert loaded["series"]["a.b"]["unit"] == "pages"
+    # malformed inputs are named, not crashed on
+    assert validate_timeseries({"schema": "nope"})
+    assert validate_timeseries(
+        {"schema": "dls.timeseries/1", "series": {"x": {}}}
+    )
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"schema": "nope"}')
+    with pytest.raises(ValueError):
+        load_timeseries(str(bad))
+
+
+def test_snapshot_at_indices():
+    store = TimeSeriesStore(capacity=32)
+    for i in range(5):
+        store.record("m", float(i * i), t=float(i))
+    store.record("short", 7.0, t=0.0)  # 1 point: skipped at index 3
+    snap = store.snapshot()
+    first = snapshot_at(snap, 0)
+    last = snapshot_at(snap, -1)
+    assert first["schema"] == "dls.metrics/1"
+    assert first["gauges"]["m"]["value"] == 0.0
+    assert last["gauges"]["m"]["value"] == 16.0
+    assert last["gauges"]["m"]["max"] == 16.0
+    mid = snapshot_at(snap, 3)
+    assert "short" not in mid["gauges"]
+    assert snapshot_at(snap, 99)["gauges"] == {}
+    with pytest.raises(ValueError):
+        snapshot_at({"schema": "nope"}, 0)
+
+
+# -- detectors -------------------------------------------------------------
+def test_detector_config_rejected():
+    with pytest.raises(ValueError):
+        Detector("x", "H", "s", threshold=0.0)
+    with pytest.raises(ValueError):
+        Detector("x", "H", "s", threshold=1.0, direction="sideways")
+    with pytest.raises(ValueError):
+        Detector("x", "H", "s", threshold=1.0, severity="meh")
+
+
+def test_detector_flat_series_is_healthy_and_missing_is_info():
+    """False-positive guard: a flat post-warmup series must not breach,
+    and an absent series yields an info finding, not a crash."""
+    store = TimeSeriesStore(capacity=64)
+    for i in range(30):
+        store.record("pool.orphan_pages", 0.0, t=0.1 * i)
+    report = HealthMonitor(warmup_s=0.5).evaluate(store)
+    assert not report.exceeds()
+    by_det = {f.detector: f for f in report.findings}
+    assert by_det["page_leak"].slope == 0.0
+    assert by_det["page_leak"].severity == "info"
+    # the other five series were never recorded
+    assert by_det["hbm_growth"].slope is None
+    assert by_det["hbm_growth"].severity == "info"
+    assert len(report.findings) == len(default_detectors())
+
+
+def test_detector_trend_breaches_and_worst_ranking():
+    store = TimeSeriesStore(capacity=64)
+    for i in range(30):
+        t = 0.1 * i
+        store.record("pool.orphan_pages", 2.0 * t, t=t)   # 40x threshold
+        store.record("throughput.tok_s", 100.0 - 30.0 * t, t=t)
+    report = HealthMonitor(warmup_s=0.0).evaluate(store)
+    assert report.exceeds()
+    codes = {f.code for f in report.breaches()}
+    assert codes == {"HLT001", "HLT006"}
+    assert report.worst_breach().code == "HLT001"
+    assert "page_leak" in report.summary()
+
+
+def test_injected_page_leak_trips_hlt001(leak_art):
+    assert leak_art["verdict"] == "breach"
+    assert leak_art["injection"] == {"page_leak_every": 2}
+    breaches = [f for f in leak_art["health"]["findings"]
+                if f["severity"] == "error"]
+    assert any(f["code"] == "HLT001" for f in breaches)
+    assert leak_art["soak.page_leak_slope_pages_s"] > 0.05
+    # the breach dumped flight rings mid-soak, naming the detector
+    assert leak_art["flight_dumps"]
+    reasons = leak_art["flight_dumps"][0]["reasons"]
+    assert any("HLT001" in r for r in reasons), reasons
+
+
+def test_injected_jit_churn_trips_hlt003():
+    art = run_soak(SoakConfig(), inject_churn=True)
+    assert art["verdict"] == "breach"
+    breaches = {f["code"] for f in art["health"]["findings"]
+                if f["severity"] == "error"}
+    assert "HLT003" in breaches
+    assert art["soak.jit_cache_slope_entries_s"] > 3.0
+
+
+# -- soak harness ----------------------------------------------------------
+def test_healthy_soak_artifact(healthy_art):
+    art = healthy_art
+    assert validate_soak_artifact(art) == []
+    assert art["verdict"] == "healthy" and art["clock"] == "virtual"
+    assert art["serving"]["pages_leaked"] == 0
+    # a healthy engine orphans exactly zero pages at any load
+    assert art["soak.page_leak_slope_pages_s"] == 0.0
+    assert art["soak.goodput_tok_s"] > 0
+    for m in SLOPE_METRICS.values():
+        assert art[m] >= 0.0
+    # every series stayed within its ring capacity
+    for name, row in art["timeseries"]["series"].items():
+        assert len(row["points"]) <= art["timeseries"]["capacity"], name
+
+
+def test_instrumented_soak_bit_identical_to_bare(healthy_art):
+    """Sampling only reads; the served-token digest of an instrumented
+    soak must equal an un-instrumented same-seed run exactly."""
+    bare = run_soak(SoakConfig(), instrument=False)
+    assert "timeseries" not in bare
+    assert bare["digest"] == healthy_art["digest"]
+    assert bare["serving"] == healthy_art["serving"]
+
+
+def test_soak_deterministic_same_seed(healthy_art):
+    again = run_soak(SoakConfig())
+    assert again == healthy_art
+
+
+def test_soak_config_rejected():
+    for bad in (
+        SoakConfig(duration_s=0.0),
+        SoakConfig(sample_every_s=0.0),
+        SoakConfig(warmup_s=5.0),          # >= duration
+        SoakConfig(rate_rps=-1.0),
+        SoakConfig(admission="vip"),
+        SoakConfig(capacity=1),
+    ):
+        with pytest.raises(ValueError):
+            bad.validate()
+
+
+def test_report_from_soak_artifact_regates(healthy_art, leak_art):
+    assert not report_from_soak_artifact(healthy_art).exceeds()
+    re = report_from_soak_artifact(leak_art)
+    assert re.exceeds()
+    assert re.worst_breach().code == "HLT001"
+    with pytest.raises(ValueError):
+        report_from_soak_artifact({"schema": "nope"})
+
+
+def test_real_clock_soak_smoke():
+    """~2s against the actual wall clock: timestamps strictly monotone,
+    zero leaked pages, schema-valid artifact.  The health VERDICT is
+    not asserted — wall time on a shared test machine is allowed to be
+    noisy; the CI soak-smoke job gates the healthy wall leg at gentler
+    load."""
+    art = run_soak(SoakConfig(
+        duration_s=2.0, warmup_s=1.0, rate_rps=2.0, ttft_s=2.0,
+        window_s=1.0, real_clock=True,
+    ))
+    assert validate_soak_artifact(art) == []
+    assert art["clock"] == "wall"
+    assert art["serving"]["pages_leaked"] == 0
+    for name, row in art["timeseries"]["series"].items():
+        stamps = [t for t, _ in row["points"]]
+        assert stamps == sorted(stamps), name
+        assert len(set(stamps)) == len(stamps), name
+
+
+# -- CLI -------------------------------------------------------------------
+def test_soak_cli_exit_codes(tmp_path):
+    from distributed_llm_scheduler_tpu.__main__ import main
+
+    ok = str(tmp_path / "soak_ok.json")
+    assert main(["soak", "--out", ok]) == 0
+    art = json.load(open(ok))
+    assert validate_soak_artifact(art) == []
+    assert art["verdict"] == "healthy"
+
+    leak = str(tmp_path / "soak_leak.json")
+    fdir = str(tmp_path / "flight")
+    assert main(["soak", "--inject-leak", "2", "--flight-dir", fdir,
+                 "--out", leak]) == 1
+    leak_obj = json.load(open(leak))
+    assert leak_obj["verdict"] == "breach"
+    assert leak_obj["flight_dumps"]
+    assert all(r["trace_valid"] for r in leak_obj["flight_dumps"])
+
+    assert main(["soak", "--duration", "-1"]) == 2
+    assert main(["soak", "--warmup", "9", "--duration", "4"]) == 2
+    assert main(["soak", "--inject-leak", "0"]) == 2
+
+    # doctor --soak re-derives both verdicts offline
+    assert main(["doctor", "--soak", ok]) == 0
+    assert main(["doctor", "--soak", leak]) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"schema": "nope"}')
+    assert main(["doctor", "--soak", str(bad)]) == 2
+
+    # metrics diff --at/--vs indexes the soak artifact's series
+    assert main(["metrics", "diff", ok, "--at", "0", "--vs", "-1"]) == 0
+    assert main(["metrics", "diff", ok, "--at", "0"]) == 2
+    assert main(["metrics", "diff", ok, ok, "--at", "0", "--vs", "1"]) == 2
+    assert main(["metrics", "diff", ok, "--at", "9999", "--vs", "-1"]) == 2
