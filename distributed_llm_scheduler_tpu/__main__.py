@@ -217,7 +217,10 @@ def cmd_lint(args) -> int:
     if args.paged:
         from .frontend.decode_dag import build_paged_decode_dag
 
-        dag = build_paged_decode_dag(cfg.model_config(), slots=cfg.batch)
+        dag = build_paged_decode_dag(
+            cfg.model_config(), slots=cfg.batch,
+            page_size=getattr(args, "page_size", 16),
+        )
     elif args.decode:
         from .frontend.decode_dag import build_decode_dag_any
 
@@ -1417,7 +1420,7 @@ def cmd_serve(args) -> int:
     mcfg = cfg.model_config()
     ddag = build_paged_decode_dag(
         mcfg, slots=slots, page_size=ps, n_pages=n_pages,
-        pages_per_seq=ppseq,
+        pages_per_seq=ppseq, attention_impl=args.attention_impl,
     )
     params = ddag.init_params()
     weights = {k: v for k, v in params.items()
@@ -1428,6 +1431,7 @@ def cmd_serve(args) -> int:
         ddag.graph, cfg.build_scheduler().schedule(ddag.graph, dcluster),
         mcfg, weights, pool, slots=slots, pages_per_seq=ppseq,
         seg_steps=4, clock=clock, flight=flight,
+        attention_impl=args.attention_impl,
     )
     fe = ServingFrontend(
         eng, arrivals, policy, admission=args.admission,
@@ -1481,6 +1485,7 @@ def cmd_soak(args) -> int:
             admission=args.admission, ttft_s=args.ttft,
             window_s=args.window, percentile=args.percentile,
             capacity=args.capacity, real_clock=args.real_clock,
+            attention_impl=args.attention_impl,
         )
         cfg.validate()
         if args.inject_leak is not None and args.inject_leak < 1:
@@ -1896,6 +1901,10 @@ def main(argv=None) -> int:
     p.add_argument("--paged", action="store_true",
                    help="lint the paged KV-cache decode-step DAG "
                         "(--batch sets the slot count; gpt2 family only)")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="rows per KV page for --paged (default 16); "
+                        "DEC005 warns when the geometry makes the fused "
+                        "Pallas kernel ineligible (gather fallback)")
     p.add_argument("--fix", action="store_true",
                    help="apply mechanical fixes before linting "
                         "(DAG003 duplicate-dependency dedup keeping the "
@@ -2165,6 +2174,12 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None, metavar="PATH",
                    help="write the full serving report (including "
                         "per-request rows) here")
+    p.add_argument("--attention-impl", default=None, dest="attention_impl",
+                   choices=("auto", "xla", "pallas", "pallas_interpret"),
+                   help="paged attention implementation baked into the "
+                        "engine (default: op-level auto — fused Pallas "
+                        "kernel on TPU when eligible, XLA gather "
+                        "otherwise)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -2217,6 +2232,10 @@ def main(argv=None) -> int:
                    dest="inject_jit_churn",
                    help="testing: plant a fresh prefill compile-cache "
                         "entry every segment — must trip HLT003")
+    p.add_argument("--attention-impl", default=None, dest="attention_impl",
+                   choices=("auto", "xla", "pallas", "pallas_interpret"),
+                   help="paged attention implementation baked into the "
+                        "engine (default: op-level auto)")
     p.set_defaults(fn=cmd_soak)
 
     p = sub.add_parser(

@@ -143,7 +143,12 @@ def analyze(
     the base passes.
     """
     rep = analyze_graph(graph)
-    rep.extend(analyze_decode(graph, cluster, schedule))
+    # DEC005 (kernel eligibility) needs the pool spec shapes; either the
+    # quantization spec table or the typecheck param table carries them
+    rep.extend(
+        analyze_decode(graph, cluster, schedule,
+                       param_specs=param_specs or params)
+    )
     if cluster is not None and schedule is not None:
         rep.extend(analyze_schedule(graph, cluster, schedule))
         rep.extend(analyze_memory(graph, cluster, schedule, strict=strict))

@@ -15,7 +15,7 @@ gets an empty report, so it is safe to run unconditionally.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set
+from typing import Any, Dict, Optional, Set
 
 from ..core.cluster import Cluster
 from ..core.graph import TaskGraph
@@ -31,6 +31,7 @@ def analyze_decode(
     graph: TaskGraph,
     cluster: Optional[Cluster] = None,
     schedule: Optional[Schedule] = None,
+    param_specs: Optional[Dict[str, Any]] = None,
 ) -> AnalysisReport:
     """Decode-loop composability checks (no-op on non-decode graphs).
 
@@ -48,6 +49,13 @@ def analyze_decode(
       disagree on geometry.
     * ``DEC004`` (info): per-step KV residency payload
       (``data={"kv_bytes": ..., "paged": ...}``).
+    * ``DEC005`` (warning, needs ``param_specs``): the paged pool
+      geometry (page_size / head_dim / kv-head layout read off the
+      ``cache_*`` pool specs) makes the fused Pallas kernel ineligible,
+      so every ``impl="auto"``/``"pallas"`` dispatch silently falls back
+      to the XLA gather path.  The message names each violated tiling
+      constraint.  A warning, never a gate: the gather path is correct,
+      just slower.
     """
     rep = AnalysisReport()
     tasks = graph.tasks()
@@ -130,6 +138,40 @@ def analyze_decode(
                 param=hi,
                 data={"pool_bytes": dict(sorted(pool_bytes.items()))},
             )
+
+    # DEC005: fused-kernel eligibility of the pool geometry --------------
+    if paged and param_specs:
+        pool_spec = next(
+            (
+                param_specs[p]
+                for p in sorted(param_specs)
+                if _is_cache_param(p) and getattr(param_specs[p], "ndim", 0) == 4
+            ),
+            None,
+        )
+        if pool_spec is not None:
+            from ..ops.attention import paged_kernel_constraints
+
+            _n_pages, page_size, n_kv, hd = pool_spec.shape
+            violated = paged_kernel_constraints(
+                page_size, hd, n_kv, dtype=pool_spec.dtype
+            )
+            if violated:
+                rep.add(
+                    "DEC005",
+                    Severity.WARNING,
+                    "paged pool geometry is ineligible for the fused "
+                    "Pallas attention kernel (impl='auto'/'pallas' "
+                    "silently falls back to the XLA gather path): "
+                    + "; ".join(violated),
+                    data={
+                        "page_size": int(page_size),
+                        "head_dim": int(hd),
+                        "n_kv_heads": int(n_kv),
+                        "dtype": str(pool_spec.dtype),
+                        "constraints": list(violated),
+                    },
+                )
 
     # DEC004: per-step KV residency payload ------------------------------
     kv_bytes: Dict[str, int] = {}

@@ -68,6 +68,8 @@ CODES: Dict[str, str] = {
     "DEC002": "decode step spans multiple nodes: scan-loop ineligible",
     "DEC003": "inconsistent paged KV wiring (pools vs page_table)",
     "DEC004": "per-step KV-cache residency (informational)",
+    "DEC005": "paged geometry ineligible for the fused Pallas kernel "
+              "(silent gather fallback)",
     # -- quantization dtype flow (quant_pass) ---------------------------
     "QNT001": "QParam with wrong component dtypes",
     "QNT002": "QParam scale shape matches no known layout",

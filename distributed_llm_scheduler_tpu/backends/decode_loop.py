@@ -335,6 +335,7 @@ class PagedDecodeEngine:
         clock: Any = None,
         memprof: Any = None,
         flight: Any = None,
+        attention_impl: Optional[str] = None,
     ):
         import numpy as np
 
@@ -355,6 +356,14 @@ class PagedDecodeEngine:
         self.pool = pool
         self.slots = slots
         self.pages_per_seq = pages_per_seq
+        # the impl is baked into the graph's layer tasks at DAG build
+        # time; the engine records it so (a) the prefill compile-class
+        # key can never alias programs traced from differently-dispatched
+        # graphs and (b) summary()/benches can report which path ran
+        self.attention_impl = (
+            attention_impl if attention_impl is not None
+            else getattr(graph, "attention_impl", None)
+        )
         self.page_size = pool.page_size
         self.capacity = pages_per_seq * pool.page_size
         self.seg_steps = seg_steps
@@ -545,6 +554,7 @@ class PagedDecodeEngine:
             "in_flight": self.slots - self.free_slots,
             "completed": len(self.results),
             "segments_run": self.segments_run,
+            "attention_impl": self.attention_impl or "auto",
             "page_occupancy": self.page_occupancy(),
         }
 
@@ -601,7 +611,7 @@ class PagedDecodeEngine:
         from ..parallel.decode import _family_of, _module_for
 
         b, P = prompt_ids.shape
-        fn = self._prefill_cache.get((P, b))
+        fn = self._prefill_cache.get((P, b, self.attention_impl))
         if fn is None:
             mod = _module_for(_family_of(self.config))
             n_layers, n_kv, hd = _cd(self.config)
@@ -634,7 +644,7 @@ class PagedDecodeEngine:
                 return first, new
 
             fn = jax.jit(_fn, donate_argnums=(1,))
-            self._prefill_cache[(P, b)] = fn
+            self._prefill_cache[(P, b, self.attention_impl)] = fn
         first, self.pools = fn(prompt_ids, self.pools, jnp.asarray(pt_rows))
         return first
 

@@ -1310,6 +1310,7 @@ class DeviceBackend:
         clock: Any = None,
         memprof: Any = None,
         flight: Any = None,
+        attention_impl: Optional[str] = None,
     ):
         """Continuous-batching paged decode engine over a SCHEDULED paged
         decode-step DAG (``frontend.build_paged_decode_dag``).
@@ -1334,7 +1335,7 @@ class DeviceBackend:
             graph, schedule, config, weights, pool,
             slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
             tracer=trace, metrics=metrics, clock=clock, memprof=memprof,
-            flight=flight,
+            flight=flight, attention_impl=attention_impl,
         )
 
     def execute(

@@ -252,6 +252,35 @@ def capture_decode() -> Dict[str, Any]:
     out["paged"] = _guarded(
         "decode.paged", lambda: _rounded(measure_paged_decode())
     )
+    # fused Pallas kernel leg (r14): the same serving workload through
+    # two engines differing only in attention impl — gather vs fused
+    # kernel ("pallas" on TPU, interpret-mode on CPU where the numbers
+    # are parity-only and the artifact discloses it)
+    from .decode_bench import measure_paged_kernel
+
+    out["paged_kernel"] = _guarded(
+        "decode.paged_kernel", lambda: measure_paged_kernel()
+    )
+    # flat decode.* keys at the artifact top level (the serve artifact's
+    # flat-key pattern) — what the regress families gate on
+    paged, kern = out["paged"], out["paged_kernel"]
+    if "error" not in paged:
+        out["decode.paged_tok_s"] = paged["paged_tok_s"]
+        out["decode.paged_speedup"] = paged["speedup"]
+        out["decode.paged_tokens_exact"] = paged["tokens_exact"]
+        out["decode.pages_leaked"] = paged["pages_leaked"]
+    if "error" not in kern:
+        out["decode.kernel_tokens_exact"] = kern["tokens_exact"]
+        out["decode.kernel_parity_ok"] = kern["parity_ok"]
+        out["decode.kernel_pages_leaked"] = (
+            kern["pages_leaked_gather"] + kern["pages_leaked_kernel"]
+        )
+        if "kernel_vs_gather_speedup" in kern:
+            # present only when measured on TPU (the CPU interpret wall
+            # is the evaluator's, not the lowered kernel's)
+            out["decode.kernel_vs_gather_speedup"] = (
+                kern["kernel_vs_gather_speedup"]
+            )
     if len(jax.devices()) >= 2:
         out["tp_sharded"] = _guarded(
             "decode.tp", lambda: measure_decode_sharded(tp=2)

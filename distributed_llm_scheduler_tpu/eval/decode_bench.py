@@ -1108,6 +1108,233 @@ def _evaluate_bench_slo(reqlog) -> Dict[str, Any]:
     return evaluate_slo(reqlog, policy).summary()
 
 
+def _paged_op_parity_fixtures(page_size: int = 16) -> list:
+    """Ragged/edge-case fixtures for the op-level kernel-vs-gather
+    parity sweep: (name, S, Hq, Hkv, hd, pages_per_seq, lengths,
+    with_insert).  Covers the ragged mixes, page-size edges (empty,
+    1-token tail, exactly-full page, single-page request), GQA ratios,
+    and capacity-1 insert clamping the tests also assert."""
+    ps = page_size
+    return [
+        ("ragged_mix", 3, 4, 2, 8, 4, [0, 5, 3 * ps + 1], True),
+        ("no_insert", 3, 4, 2, 8, 4, [1, ps, 2 * ps - 1], False),
+        ("mha_heads", 2, 2, 2, 8, 2, [ps - 1, ps + 3], True),
+        ("gqa_4to1", 2, 8, 2, 16, 2, [3, 2 * ps - 2], True),
+        ("single_page", 2, 4, 2, 8, 1, [1, ps - 1], True),
+        ("page_boundary", 2, 4, 2, 8, 2, [ps, 2 * ps - 1], True),
+        ("capacity_edge", 2, 4, 2, 8, 2, [2 * ps - 1, 2 * ps - 1], True),
+    ]
+
+
+def _paged_op_parity(kernel_impl: str, page_size: int = 16) -> Dict[str, Any]:
+    """Op-level allclose sweep: ``paged_decode_attention`` under
+    ``kernel_impl`` vs the XLA gather path on randomized paged state
+    (trash page poisoned) across every fixture.  Returns per-fixture
+    max |err| and the aggregate parity verdict."""
+    import numpy as np
+
+    from ..models.kv_pages import TRASH_PAGE
+    from ..ops.attention import paged_decode_attention
+
+    rng = np.random.RandomState(3)
+    ps = page_size
+    out = {}
+    ok = True
+    for name, S, Hq, Hkv, hd, ppseq, lengths, with_insert in \
+            _paged_op_parity_fixtures(ps):
+        n_pages = S * ppseq + 1
+        q = jnp.asarray(rng.randn(S, Hq, 1, hd), jnp.float32)
+        k_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+        v_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+        # poison the trash page: parity then also proves the masking
+        k_pool = k_pool.at[TRASH_PAGE].set(1e9)
+        v_pool = v_pool.at[TRASH_PAGE].set(1e9)
+        pt = np.full((S, ppseq), TRASH_PAGE, np.int32)
+        page = 1
+        for s, L in enumerate(lengths):
+            for j in range((min(L + 1, ppseq * ps) + ps - 1) // ps):
+                pt[s, j] = page
+                page += 1
+        pt = jnp.asarray(pt)
+        ln = jnp.asarray(lengths, jnp.int32)
+        kn = vn = None
+        if with_insert:
+            kn = jnp.asarray(rng.randn(S, Hkv, 1, hd), jnp.float32)
+            vn = jnp.asarray(rng.randn(S, Hkv, 1, hd), jnp.float32)
+        ref = paged_decode_attention(
+            q, k_pool, v_pool, pt, ln, 1.0 / hd ** 0.5,
+            k_new=kn, v_new=vn, impl="xla",
+        )
+        got = paged_decode_attention(
+            q, k_pool, v_pool, pt, ln, 1.0 / hd ** 0.5,
+            k_new=kn, v_new=vn, impl=kernel_impl,
+        )
+        err = float(jnp.max(jnp.abs(got - ref)))
+        close = bool(jnp.allclose(got, ref, atol=1e-5, rtol=1e-5))
+        ok = ok and close
+        out[name] = {"max_abs_err": round(err, 9), "allclose": close}
+    return {"fixtures": out, "allclose": ok}
+
+
+def measure_paged_kernel(
+    config=None,
+    slots: int = 4,
+    page_size: int = 16,
+    pages_per_seq: int = 8,
+    n_pages: int = 64,
+    seg_steps: int = 8,
+    n_requests: int = 12,
+    reps: int = 5,
+) -> Dict[str, Any]:
+    """Fused Pallas kernel leg: the SAME serving workload as
+    :func:`measure_paged_decode`, run through two paged engines that
+    differ ONLY in attention impl — ``"xla"`` (gather-by-page-table)
+    vs the fused kernel (``"pallas"`` on TPU, ``"pallas_interpret"``
+    on CPU/GPU where Mosaic cannot lower).
+
+    Gates encoded by the ``--kernel`` CLI branch:
+
+    * retired tokens bitwise-identical between the impls (greedy argmax
+      through the full engine, both platforms);
+    * op-level allclose across the ragged/edge-case fixture sweep;
+    * zero leaked pages on both engines;
+    * on TPU only: kernel wall-clock >= 1.1x the gather path
+      (``kernel_vs_gather_speedup``).  On CPU the interpret kernel is
+      an evaluator, not a lowering — wall-clock is meaningless, so the
+      artifact discloses ``cpu_interpret_parity_only: true`` and the
+      speedup key is present only when measured on TPU (mirrors the
+      CPU-fallback scaling disclosure of the sharded legs).
+    """
+    import time
+
+    import numpy as np
+
+    from ..backends.device import DeviceBackend
+    from ..core.cluster import Cluster
+    from ..frontend.decode_dag import build_paged_decode_dag
+    from ..models.kv_pages import PagePool
+    from ..ops.attention import paged_pallas_supported
+    from ..parallel.decode import _family_of, _module_for
+    from ..sched.policies import get_scheduler
+
+    if config is None:
+        from ..models.gpt2 import GPT2Config
+
+        config = GPT2Config.tiny()
+    mod = _module_for(_family_of(config))
+    capacity = pages_per_seq * page_size
+    params = mod.init_params(config, jax.random.PRNGKey(0))
+    weights = {
+        k: v for k, v in params.items()
+        if not (k.startswith("cache_") or k == "page_table")
+    }
+    from ..frontend.decode_dag import cache_dims
+
+    _n_layers, n_kv_heads, head_dim = cache_dims(config)
+
+    on_tpu = jax.default_backend() == "tpu"
+    kernel_impl = "pallas" if on_tpu else "pallas_interpret"
+
+    # same workload as measure_paged_decode: two prompt lengths, skewed
+    # generation mix, rng seed 7 — recognizably the serving shape
+    rng = np.random.RandomState(7)
+    prompt_lens = [16 if i < n_requests // 2 else 24
+                   for i in range(n_requests)]
+    gen_pattern = [capacity - 24, 8, 8, 8]
+    reqs = []
+    for i in range(n_requests):
+        P = prompt_lens[i]
+        gen = min(gen_pattern[i % len(gen_pattern)], capacity - P)
+        ids = jnp.asarray(
+            rng.randint(0, config.vocab_size, (1, P)), jnp.int32
+        )
+        reqs.append((f"r{i}", ids, gen))
+    useful_tokens = sum(g for _, _, g in reqs)
+
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+
+    def build_engine(impl):
+        dag = build_paged_decode_dag(
+            config, slots=slots, page_size=page_size, n_pages=n_pages,
+            pages_per_seq=pages_per_seq, attention_impl=impl,
+        )
+        sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+        pool = PagePool(n_pages=n_pages, page_size=page_size)
+        eng = backend.paged_decode_engine(
+            dag.graph, sched, config, weights, pool,
+            slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
+            attention_impl=impl,
+        )
+        return eng, pool
+
+    eng_x, pool_x = build_engine("xla")
+    eng_k, pool_k = build_engine(kernel_impl)
+
+    def run(eng):
+        for rid, ids, gen in reqs:
+            eng.submit(rid, ids, gen)
+        return dict(eng.run())
+
+    toks_x = run(eng_x)  # compile warmup pass
+    toks_k = run(eng_k)
+    tokens_exact = all(
+        np.array_equal(np.asarray(toks_x[rid]), np.asarray(toks_k[rid]))
+        for rid, _, _ in reqs
+    )
+    leaked_x = (pool_x.n_pages - 1) - pool_x.free_pages
+    leaked_k = (pool_k.n_pages - 1) - pool_k.free_pages
+
+    # interleaved reps, median walls (same discipline as the paged leg)
+    walls_x, walls_k = [], []
+    for _ in range(reps):
+        eng_x.reset()
+        t0 = time.perf_counter()
+        run(eng_x)
+        walls_x.append(time.perf_counter() - t0)
+        eng_k.reset()
+        t0 = time.perf_counter()
+        run(eng_k)
+        walls_k.append(time.perf_counter() - t0)
+    wall_x = sorted(walls_x)[len(walls_x) // 2]
+    wall_k = sorted(walls_k)[len(walls_k) // 2]
+
+    parity = _paged_op_parity(kernel_impl, page_size=page_size)
+    res: Dict[str, Any] = {
+        "platform": jax.default_backend(),
+        "kernel_impl": kernel_impl,
+        "kernel_geometry_eligible": bool(paged_pallas_supported(
+            (slots, n_kv_heads, 1, head_dim),
+            (n_pages, page_size, n_kv_heads, head_dim),
+        )),
+        "n_requests": n_requests,
+        "useful_tokens": useful_tokens,
+        "page_size": page_size,
+        "pages_per_seq": pages_per_seq,
+        "gather_tok_s": round(useful_tokens / max(wall_x, 1e-9), 4),
+        "kernel_tok_s": round(useful_tokens / max(wall_k, 1e-9), 4),
+        "tokens_exact": bool(tokens_exact),
+        "pages_leaked_gather": int(leaked_x),
+        "pages_leaked_kernel": int(leaked_k),
+        "parity": parity,
+        "parity_ok": bool(parity["allclose"]),
+    }
+    if on_tpu:
+        # wall-clock gate is only meaningful where the kernel lowers
+        res["kernel_vs_gather_speedup"] = round(
+            wall_x / max(wall_k, 1e-9), 4
+        )
+    else:
+        res["cpu_interpret_parity_only"] = True
+        res["disclosure"] = (
+            "interpret-mode kernel on a non-TPU backend: Pallas "
+            "evaluates per-block on the host, so wall-clock is not "
+            "the lowered kernel's — parity and leak gates only; the "
+            ">=1.1x speedup gate applies on TPU"
+        )
+    return res
+
+
 def _round4(d):
     return {
         k: (round(v, 4) if isinstance(v, float) else v)
@@ -1167,6 +1394,60 @@ if __name__ == "__main__":
             f"PAGED GATES PASS: {res['paged_tok_s']:.0f} tok/s paged vs "
             f"{res['dense_tok_s']:.0f} dense ({res['speedup']:.2f}x), "
             f"tokens exact over {res['n_requests']} requests",
+            file=sys.stderr,
+        )
+        sys.exit(0)
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--kernel":
+        # CI kernel gate: fused kernel vs gather path on the same
+        # serving workload — bitwise tokens + op allclose + zero leaks
+        # everywhere; >= 1.1x wall-clock only where the kernel lowers
+        # (TPU; CPU interpret numbers are disclosed non-gating)
+        out_path = None
+        if "--out" in sys.argv:
+            out_path = sys.argv[sys.argv.index("--out") + 1]
+        res = measure_paged_kernel()
+        print(json.dumps(res))
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(res, f, indent=1)
+        failures = []
+        if not res["tokens_exact"]:
+            failures.append(
+                "kernel engine tokens diverge from the gather engine"
+            )
+        if not res["parity_ok"]:
+            bad = [n for n, r in res["parity"]["fixtures"].items()
+                   if not r["allclose"]]
+            failures.append(f"op-level parity failed on {bad}")
+        if res["pages_leaked_gather"] or res["pages_leaked_kernel"]:
+            failures.append(
+                f"pages leaked (gather {res['pages_leaked_gather']}, "
+                f"kernel {res['pages_leaked_kernel']})"
+            )
+        if "kernel_vs_gather_speedup" in res:
+            if res["kernel_vs_gather_speedup"] < 1.1:
+                failures.append(
+                    f"kernel {res['kernel_tok_s']} tok/s vs gather "
+                    f"{res['gather_tok_s']} tok/s: speedup "
+                    f"{res['kernel_vs_gather_speedup']} < 1.1x TPU gate"
+                )
+        else:
+            print(
+                "KERNEL GATE NOTE: non-TPU backend, interpret-mode "
+                "parity only (speedup gate skipped, disclosed in "
+                "artifact)", file=sys.stderr,
+            )
+        for f_ in failures:
+            print(f"KERNEL GATE FAIL: {f_}", file=sys.stderr)
+        if failures:
+            sys.exit(1)
+        print(
+            f"KERNEL GATES PASS: {res['kernel_impl']} tokens exact over "
+            f"{res['n_requests']} requests, op parity across "
+            f"{len(res['parity']['fixtures'])} fixtures, zero leaks"
+            + (f", {res['kernel_vs_gather_speedup']:.2f}x vs gather"
+               if "kernel_vs_gather_speedup" in res else ""),
             file=sys.stderr,
         )
         sys.exit(0)

@@ -49,6 +49,9 @@ LOWER_BETTER = (
     "soak.ttft_p95_slope_s_per_s",
     "soak.queue_wait_p95_slope_s_per_s",
     "soak.throughput_decay_tok_s2",
+    # paged decode legs: any leaked page is an engine bug
+    "decode.pages_leaked",
+    "decode.kernel_pages_leaked",
 )
 
 # lower-is-better metric FAMILIES, matched by prefix: per-device peak
@@ -76,6 +79,15 @@ METRIC_DEFAULT_TOLERANCES = {
     # functions of the seed, so exact match is the right band even
     # though healthy hbm/jit/latency slopes are nonzero
     "soak": 0.0,
+    # paged decode legs: leak counts and parity are deterministic;
+    # throughputs and speedups are wall-clock on shared CI hosts, so
+    # they get wide bands (the hard >=1.0x/>=1.1x floors live in the
+    # decode_bench gates, not here)
+    "decode.pages_leaked": 0.0,
+    "decode.kernel_pages_leaked": 0.0,
+    "decode.paged_tok_s": 0.35,
+    "decode.paged_speedup": 0.35,
+    "decode.kernel_vs_gather_speedup": 0.35,
 }
 HIGHER_BETTER = (
     "vs_baseline",
@@ -84,8 +96,16 @@ HIGHER_BETTER = (
     "mfu_compiled",
     "serve.goodput_tok_s",
     "soak.goodput_tok_s",
+    "decode.paged_tok_s",
+    "decode.paged_speedup",
+    "decode.kernel_vs_gather_speedup",
 )
-BOOL_METRICS = ("oracle_ok",)
+BOOL_METRICS = (
+    "oracle_ok",
+    "decode.paged_tokens_exact",
+    "decode.kernel_tokens_exact",
+    "decode.kernel_parity_ok",
+)
 
 # the default comparison set: quality metrics only — environment
 # measurements (fence RTT, replay wall) drift with the machine and are
@@ -105,6 +125,11 @@ DEFAULT_METRICS = (
     "serve.goodput_tok_s",
     "serve.ttft_p99_ms",
     "serve.queue_wait_p95_ms",
+    "decode.paged_tokens_exact",
+    "decode.pages_leaked",
+    "decode.kernel_tokens_exact",
+    "decode.kernel_parity_ok",
+    "decode.kernel_pages_leaked",
 )
 
 DEFAULT_TOLERANCE = 0.10

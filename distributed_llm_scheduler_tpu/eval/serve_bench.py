@@ -70,10 +70,14 @@ def build_serve_engine(
     clock: Any = None,
     flight: Any = None,
     metrics: Any = None,
+    attention_impl: Any = None,
 ):
     """One tiny-GPT2 paged engine on the first CPU/TPU device, built
     through ``DeviceBackend.paged_decode_engine`` (pre-execution gate
-    included) — the same construction the slo CLI and tests use."""
+    included) — the same construction the slo CLI and tests use.
+
+    ``attention_impl`` is baked into the DAG's layer tasks (``xla`` /
+    ``pallas`` / ``pallas_interpret`` / ``auto``; None = op auto)."""
     import jax
 
     from ..backends.device import DeviceBackend
@@ -86,7 +90,7 @@ def build_serve_engine(
     cfg = gpt2.GPT2Config.tiny()
     dag = build_paged_decode_dag(
         cfg, slots=slots, page_size=page_size, n_pages=n_pages,
-        pages_per_seq=pages_per_seq,
+        pages_per_seq=pages_per_seq, attention_impl=attention_impl,
     )
     params = dag.init_params()
     weights = {
@@ -100,6 +104,7 @@ def build_serve_engine(
         dag.graph, sched, cfg, weights, pool,
         slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
         clock=clock, flight=flight, metrics=metrics,
+        attention_impl=attention_impl,
     )
     return eng, pool
 

@@ -467,6 +467,8 @@ class PagedDecodeDAG(ModelDAG):
     slots: int = 1
     page_size: int = 0
     pages_per_seq: int = 0
+    #: attention impl baked into the layer tasks (None = op-level auto)
+    attention_impl: Optional[str] = None
 
     def make_inputs(self, key: Optional[jax.Array] = None,
                     lengths: Optional[Any] = None) -> Dict[str, jax.Array]:
@@ -491,6 +493,7 @@ def build_paged_decode_dag(
     n_pages: int = 64,
     pages_per_seq: int = 8,
     effective_flops: float = DEFAULT_EFFECTIVE_FLOPS,
+    attention_impl: Optional[str] = None,
 ) -> PagedDecodeDAG:
     """Paged single-token decode step as a task DAG (GPT-2 family).
 
@@ -509,10 +512,21 @@ def build_paged_decode_dag(
     The step is scheduler-placed exactly like the dense decode DAG; the
     continuous-batching loop (``backends/decode_loop.py``) composes it
     into scanned K-step segments.
+
+    ``attention_impl`` selects the paged attention implementation baked
+    into every layer task (``"xla"`` gather, ``"pallas"`` fused kernel,
+    ``"pallas_interpret"``, ``"auto"``); ``None`` leaves the op on its
+    own auto dispatch (kernel on TPU when the geometry qualifies, gather
+    otherwise).  The choice is part of the graph's identity — the graph
+    name carries it, so schedules/compile caches keyed on the graph
+    never alias two impls.
     """
     from ..models.kv_pages import TRASH_PAGE, init_paged_kv
-    from ..ops.attention import paged_decode_attention
+    from ..ops.attention import paged_decode_attention, resolve_attention_impl
 
+    if attention_impl is not None:
+        # fail at build time on a typo, not at first trace inside a task
+        resolve_attention_impl(attention_impl, lambda _i: True)
     config = config or GPT2Config.tiny()
     if n_pages < 2:
         raise ValueError(f"n_pages must be >= 2 (page 0 is reserved), "
@@ -566,7 +580,7 @@ def build_paged_decode_dag(
         q, k, v = heads(q), heads(k), heads(v)
         att = paged_decode_attention(
             q, p["cache_k"], p["cache_v"], p["page_table"], lengths,
-            scale, k_new=k, v_new=v,
+            scale, k_new=k, v_new=v, impl=attention_impl,
         )
         att = att.transpose(0, 2, 1, 3).reshape(S, 1, D)
         x = x + (att @ p["attn_proj_w"] + p["attn_proj_b"])
@@ -618,6 +632,7 @@ def build_paged_decode_dag(
         f"gpt2paged_{config.n_layer}l_d{D}_s{S}_ps{ps}_p{n_pages}"
         + ("" if config.dtype == jnp.float32
            else f"_{jnp.dtype(config.dtype).name}")
+        + ("" if attention_impl is None else f"_att{attention_impl}")
     )
 
     def init_fn(key):
@@ -662,6 +677,9 @@ def build_paged_decode_dag(
         return jnp.concatenate(outs, axis=0)
 
     graph = TaskGraph(tasks, name=name).freeze()
+    # stamped on the graph too: the engine receives the bare TaskGraph
+    # and keys its prefill compile-class cache on the impl
+    graph.attention_impl = attention_impl
     dag = PagedDecodeDAG(
         graph=graph,
         config=config,
@@ -673,6 +691,7 @@ def build_paged_decode_dag(
     dag.slots = S
     dag.page_size = ps
     dag.pages_per_seq = pages_per_seq
+    dag.attention_impl = attention_impl
     return dag
 
 

@@ -1,10 +1,10 @@
-"""Fused causal flash attention as a Pallas TPU kernel.
+"""Fused attention kernels (Pallas TPU): causal flash prefill + ragged paged decode.
 
 The hot op of every model family (SURVEY.md §7 "hot parts"): materializing
 the (T, T) score matrix costs O(T^2) HBM traffic, which at long context is
-the bandwidth bottleneck.  This kernel streams K/V blocks through VMEM with
-an online-softmax accumulator (running max / denominator), so scores never
-leave VMEM and HBM traffic is O(T · d).  The same math drives the ring
+the bandwidth bottleneck.  The flash kernel streams K/V blocks through VMEM
+with an online-softmax accumulator (running max / denominator), so scores
+never leave VMEM and HBM traffic is O(T · d).  The same math drives the ring
 attention loop in :mod:`..parallel.ring_attention` — there blocks rotate
 across chips over ICI; here they stream within one chip's HBM→VMEM.
 
@@ -14,6 +14,15 @@ prunes the loop: Q block ``i`` only visits K/V blocks ``0..i`` (the trip
 count is a traced value — Pallas lowers it to a hardware loop, no
 recompilation per block).  Scores/accumulators are float32 for stability;
 inputs/outputs stay in the model dtype (bfloat16 on TPU hits the MXU).
+
+The decode-side sibling is the ragged paged kernel (``_paged_kernel``):
+grid (slot, logical page), where each grid step's K/V block is selected by
+the request's page table through a scalar-prefetch index map — one physical
+page DMAs HBM→VMEM per step, the gathered (S, M, Hkv, hd) view is never
+materialized, and the same online-softmax carry runs across a slot's pages
+(ragged tail and trash pages masked to −inf).  Both paged impls sit behind
+:func:`paged_decode_attention`'s ``impl`` switch with the same dispatch
+rules as :func:`mha` (:func:`resolve_attention_impl`).
 
 ``mha`` is the public entry: it dispatches to the kernel on TPU (or
 interpreter mode for CPU tests) and to a plain-XLA reference elsewhere, so
@@ -29,7 +38,7 @@ from __future__ import annotations
 import functools
 import math
 import os
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -191,6 +200,94 @@ def pallas_supported(q_shape, block_min: int = 8) -> bool:
     return T >= 2 * block_min and _pick_block(T) >= block_min
 
 
+def resolve_attention_impl(impl: Optional[str], supported) -> str:
+    """The ONE dispatch rule shared by the dense (:func:`mha`) and paged
+    (:func:`paged_decode_attention`) entry points, so the two paths cannot
+    drift on platform/eligibility behavior.
+
+    ``None`` / ``"auto"`` resolve via :func:`_auto_impl` (the
+    ``DLS_TPU_ATTENTION_IMPL`` env override, else pallas-on-TPU / xla
+    elsewhere).  A pallas impl the shape does not qualify for silently
+    downgrades to ``"xla"`` — ``supported`` is a callable taking the
+    resolved impl name (``"pallas"`` / ``"pallas_interpret"``), so callers
+    can keep compiled-mode tiling constraints out of the interpret path.
+    Anything outside the three known impls raises ``ValueError``.
+    """
+    if impl is None or impl == "auto":
+        impl = _auto_impl()
+        if impl == "auto":  # env var literally forced "auto": no loop
+            impl = "xla"
+    if impl not in ("xla", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if impl != "xla" and not supported(impl):
+        return "xla"
+    return impl
+
+
+def paged_kernel_constraints(
+    page_size: int,
+    head_dim: int,
+    n_kv_heads: int,
+    n_q_heads: Optional[int] = None,
+    dtype: Any = jnp.float32,
+) -> list:
+    """Violated tiling/layout constraints for the COMPILED ragged paged
+    kernel — empty list means the geometry is kernel-eligible.
+
+    One source of truth for three consumers: the ``impl="auto"``/
+    ``"pallas"`` dispatch (silent gather fallback when non-empty), the
+    DEC005 analysis warning (which quotes these strings verbatim), and the
+    docs.  The constraints are the VMEM block shapes the kernel asks for:
+    each grid step loads one ``(page_size, n_kv_heads, head_dim)`` page,
+    so ``page_size`` must fill the dtype's sublane tile and ``head_dim``
+    must pack the 8-row sublane dimension of the score/accumulator tiles
+    (interpret mode has no tiling and skips this check entirely).
+    """
+    sublane = {2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)
+    out = []
+    if page_size % sublane:
+        out.append(
+            f"page_size {page_size} is not a multiple of the {sublane}-row "
+            f"sublane tile for {jnp.dtype(dtype).name} K/V page blocks"
+        )
+    if head_dim % 8:
+        out.append(
+            f"head_dim {head_dim} is not a multiple of the 8-lane sublane "
+            "tile of the per-page score/accumulator blocks"
+        )
+    if n_kv_heads < 1:
+        out.append(f"n_kv_heads {n_kv_heads} must be >= 1")
+    if n_q_heads is not None and n_q_heads % max(n_kv_heads, 1):
+        out.append(
+            f"n_q_heads {n_q_heads} is not a multiple of n_kv_heads "
+            f"{n_kv_heads} (GQA group mapping)"
+        )
+    return out
+
+
+def paged_pallas_supported(
+    q_shape, pool_shape, interpret: bool = False
+) -> bool:
+    """Eligibility of the ragged paged kernel for this call.
+
+    Structural preconditions (every mode): single-token query, query heads
+    an exact multiple of KV heads, matching head_dim.  Compiled mode
+    additionally requires the :func:`paged_kernel_constraints` tiling
+    rules; interpret mode (CPU parity tests) has no tiling constraints.
+    """
+    S, Hq, Tn, hd = q_shape
+    n_pages, page_size, Hkv, pool_hd = pool_shape
+    if Tn != 1 or Hkv < 1 or Hq % Hkv or hd != pool_hd:
+        return False
+    if not _HAS_PLTPU:  # PrefetchScalarGridSpec lives in pltpu
+        return False
+    if interpret:
+        return True
+    return not paged_kernel_constraints(
+        page_size, hd, Hkv, n_q_heads=Hq
+    )
+
+
 def mha(
     q: jax.Array,
     k: jax.Array,
@@ -202,13 +299,12 @@ def mha(
     """Multi-head attention on (B, H, T, hd) tensors.
 
     impl: "pallas" (TPU kernel), "pallas_interpret" (CPU-debuggable kernel),
-    "xla" (reference einsum path), or None = auto (pallas on TPU when the
-    shape qualifies, xla otherwise).
+    "xla" (reference einsum path), or None/"auto" = auto (pallas on TPU
+    when the shape qualifies, xla otherwise).
     """
-    if impl is None:
-        impl = _auto_impl()
-    if impl.startswith("pallas") and not pallas_supported(q.shape):
-        impl = "xla"
+    impl = resolve_attention_impl(
+        impl, lambda _i: pallas_supported(q.shape)
+    )
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if impl == "pallas" or impl == "pallas_interpret":
         return _flash_with_vjp(
@@ -217,9 +313,148 @@ def mha(
             _pick_block(q.shape[-2]),
             impl == "pallas_interpret",
         )(q, k, v)
-    if impl == "xla":
-        return reference_mha(q, k, v, causal=causal, sm_scale=scale)
-    raise ValueError(f"unknown attention impl {impl!r}")
+    return reference_mha(q, k, v, causal=causal, sm_scale=scale)
+
+
+def _paged_kernel(
+    pt_ref, len_ref, q_ref, k_ref, v_ref, kn_ref, vn_ref, o_ref,
+    acc_ref, m_ref, l_ref, *, sm_scale, page_size, groups, has_new,
+):
+    """One (slot, logical page) grid step of the ragged paged kernel.
+
+    The grid walks slot-major / page-minor, so the online-softmax carry
+    (``acc``/``m``/``l`` VMEM scratch, persistent across grid steps) is
+    initialized at a slot's first page and folded into ``o_ref`` at its
+    last.  ``k_ref``/``v_ref`` hold ONE physical page — the BlockSpec
+    index map reads the scalar-prefetched page table, so the DMA engine
+    fetches exactly ``page_table[s, j]`` and the gathered view never
+    exists in HBM.  Masking: global row position ``j*page_size + r`` must
+    be ``<= lengths[s]`` — the same comparison that masks the ragged tail
+    also zeroes every trash-page row (a live sequence's length never
+    reaches into an unallocated page).  ``has_new`` statically compiles
+    in the write-then-attend insert: the page containing position
+    ``lengths[s]`` gets this step's K/V row substituted before the scores
+    (clamped to the last row like the gather path's
+    ``dynamic_update_slice``).
+    """
+    s_idx = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    L = len_ref[s_idx]
+    hd = q_ref.shape[-1]
+    Hkv = k_ref.shape[2]
+    q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(Hkv, groups, hd)
+    k = k_ref[0].astype(jnp.float32)  # (page_size, Hkv, hd)
+    v = v_ref[0].astype(jnp.float32)
+    if has_new:
+        # insert this step's row at position L (clamped to the capacity's
+        # last row — dynamic_update_slice semantics, gather-path parity)
+        capacity = n_j * page_size
+        ins = jnp.minimum(L, capacity - 1) - j * page_size
+        sel = (
+            jax.lax.broadcasted_iota(jnp.int32, (page_size, 1, 1), 0) == ins
+        )
+        k = jnp.where(sel, kn_ref[0].astype(jnp.float32)[None], k)
+        v = jnp.where(sel, vn_ref[0].astype(jnp.float32)[None], v)
+    # scores (Hkv, page_size, G): K @ q, the gather path's orientation
+    s = jax.lax.dot_general(
+        k, q, (((2,), (2,)), ((1,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    pos = (
+        jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page_size
+    )
+    s = jnp.where(pos <= L, s, _NEG_INF)
+    # position 0 is unmasked for every slot, so after page 0 the running
+    # max is a real (finite) score and the exp() arguments stay finite
+    m_prev = m_ref[...]                       # (Hkv, G)
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None, :])        # (Hkv, page_size, G)
+    l_ref[...] = l_ref[...] * alpha + p.sum(axis=1)
+    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )  # (Hkv, G, hd)
+    m_ref[...] = m_new
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        out = acc_ref[...] / l_ref[...][:, :, None]
+        o_ref[0] = out.reshape(Hkv * groups, hd).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "has_new", "interpret")
+)
+def _paged_flash(
+    q, k_pool, v_pool, page_table, lengths, k_new, v_new, *,
+    sm_scale, has_new, interpret,
+):
+    """Fused ragged paged attention: page-table-directed block loads.
+
+    Grid (slots, pages_per_seq); the page table and lengths ride as
+    scalar-prefetch operands so the K/V BlockSpec index maps can point
+    each grid step's DMA at the slot's physical page.  Per grid step the
+    only HBM traffic is one (page_size, Hkv, hd) page per pool — the
+    dense gather's (S, M, Hkv, hd) intermediate never exists.
+    """
+    S, Hq, _, hd = q.shape
+    _, page_size, Hkv, _ = k_pool.shape
+    G = Hq // Hkv
+    ppseq = page_table.shape[1]
+    q3 = q.reshape(S, Hq, hd)
+    if has_new:
+        kn = k_new.reshape(S, Hkv, hd)
+        vn = v_new.reshape(S, Hkv, hd)
+    else:  # zero placeholders keep the arity static; kernel never reads
+        kn = jnp.zeros((S, Hkv, hd), k_pool.dtype)
+        vn = jnp.zeros((S, Hkv, hd), v_pool.dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, ppseq),
+        in_specs=[
+            pl.BlockSpec((1, Hq, hd), lambda s, j, pt, ln: (s, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, Hkv, hd),
+                lambda s, j, pt, ln: (pt[s, j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, Hkv, hd),
+                lambda s, j, pt, ln: (pt[s, j], 0, 0, 0),
+            ),
+            pl.BlockSpec((1, Hkv, hd), lambda s, j, pt, ln: (s, 0, 0)),
+            pl.BlockSpec((1, Hkv, hd), lambda s, j, pt, ln: (s, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Hq, hd), lambda s, j, pt, ln: (s, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G, hd), jnp.float32),
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, sm_scale=sm_scale, page_size=page_size,
+            groups=G, has_new=has_new,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Hq, hd), q.dtype),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+        q3, k_pool, v_pool, kn, vn,
+    )
+    return out.reshape(S, Hq, 1, hd)
 
 
 def paged_decode_attention(
@@ -255,25 +490,38 @@ def paged_decode_attention(
     (S, Hkv, M, G), softmax over M) — deliberately, for two reasons:
     scores are elementwise identical to the dense cache's (the parity
     the mixed-length benchmark gates on), and the (pages, page_size)
-    leading axes of the pools are exactly the block structure a Pallas
-    ragged-paged-attention kernel consumes, so the kernel drops in
-    behind ``impl="pallas"`` without changing this contract.  Until
-    then ``impl`` accepts "xla" (default); "pallas" raises.
-    """
-    if impl is None:
-        impl = "xla"
-    if impl != "xla":
-        raise NotImplementedError(
-            f"paged attention impl {impl!r}: only the XLA path exists; "
-            "the Pallas ragged kernel slots in behind this signature "
-            "(pools are already page-blocked on the leading axes)"
-        )
-    from ..models.kv_pages import gather_kv_flat  # lazy: models imports ops
+    leading axes of the pools are exactly the block structure the Pallas
+    ragged-paged-attention kernel (:func:`_paged_flash`) consumes.
 
+    ``impl`` mirrors :func:`mha`: ``"xla"`` is the gather path above,
+    ``"pallas"`` the fused kernel (page-table-directed VMEM block loads,
+    online softmax — no gathered intermediate), ``"pallas_interpret"``
+    the same kernel through the Pallas interpreter (CPU parity tests),
+    and ``None``/``"auto"`` picks the kernel on TPU when the geometry
+    passes :func:`paged_kernel_constraints`, the gather path otherwise
+    (the silent-fallback seam DEC005 warns about).  Kernel outputs are
+    allclose — not bitwise — to the gather path (page-blocked online
+    softmax associates its reductions differently), which keeps greedy
+    argmax tokens identical at engine scale (pinned by the parity gate).
+    """
     S, Hq, Tn, hd = q.shape
     if Tn != 1:
         raise ValueError(f"paged decode attention is single-token, Tn={Tn}")
+    impl = resolve_attention_impl(
+        impl,
+        lambda i: paged_pallas_supported(
+            q.shape, k_pool.shape, interpret=(i == "pallas_interpret")
+        ),
+    )
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    if impl in ("pallas", "pallas_interpret"):
+        return _paged_flash(
+            q, k_pool, v_pool, page_table, lengths, k_new, v_new,
+            sm_scale=float(scale), has_new=k_new is not None,
+            interpret=impl == "pallas_interpret",
+        )
+    from ..models.kv_pages import gather_kv_flat  # lazy: models imports ops
+
     # flat (S, M, Hkv, hd) gather: a free reshape of the page gather's
     # output, where the dense (S, Hkv, M, hd) orientation would pay a
     # materializing transpose of the whole working set every step.  The

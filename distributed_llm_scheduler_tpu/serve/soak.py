@@ -75,6 +75,8 @@ class SoakConfig:
     percentile: str = "p95"
     capacity: int = 512
     real_clock: bool = False
+    #: paged attention impl baked into the engine's DAG (None = op auto)
+    attention_impl: Optional[str] = None
 
     def validate(self) -> None:
         """Raises ``ValueError`` on a malformed config (CLI exit 2)."""
@@ -97,6 +99,10 @@ class SoakConfig:
             )
         if self.capacity < 2:
             raise ValueError(f"capacity must be >= 2, got {self.capacity}")
+        if self.attention_impl is not None:
+            from ..ops.attention import resolve_attention_impl
+
+            resolve_attention_impl(self.attention_impl, lambda _i: True)
 
 
 # -- test-only fault injectors ---------------------------------------------
@@ -186,6 +192,7 @@ def run_soak(
         n_pages=SCENARIO["n_pages"],
         pages_per_seq=SCENARIO["pages_per_seq"],
         seg_steps=SCENARIO["seg_steps"], clock=clock, flight=flight,
+        attention_impl=cfg.attention_impl,
     )
     injection: Dict[str, Any] = {}
     if inject_leak_every is not None:

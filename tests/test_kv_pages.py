@@ -196,18 +196,26 @@ def test_paged_attention_bitwise_dense_parity(lengths):
         )
 
 
-def test_paged_attention_pallas_is_a_seam():
+def test_paged_attention_impl_dispatch():
+    """The seam is real now: an explicit ``pallas`` on an ineligible
+    geometry silently downgrades to the gather path (bitwise-equal
+    output — DEC005 is the observability for it), and an unknown impl
+    is a hard error."""
     from distributed_llm_scheduler_tpu.ops.attention import (
         paged_decode_attention,
     )
 
-    z = jnp.zeros((1, 2, 1, 4), jnp.float32)
+    # page_size 4 / head_dim 4 violate the lowering tile constraints,
+    # so impl="pallas" must fall back to the gather path
+    z = jnp.ones((1, 2, 1, 4), jnp.float32)
     pool = jnp.zeros((2, 4, 2, 4), jnp.float32)
     pt = jnp.zeros((1, 2), jnp.int32)
-    with pytest.raises(NotImplementedError):
-        paged_decode_attention(
-            z, pool, pool, pt, jnp.zeros((1,), jnp.int32), impl="pallas"
-        )
+    L = jnp.zeros((1,), jnp.int32)
+    got = paged_decode_attention(z, pool, pool, pt, L, impl="pallas")
+    ref = paged_decode_attention(z, pool, pool, pt, L, impl="xla")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        paged_decode_attention(z, pool, pool, pt, L, impl="triton")
 
 
 # -- continuous batching engine ---------------------------------------------

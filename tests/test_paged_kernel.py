@@ -1,0 +1,280 @@
+"""Fused Pallas ragged paged attention kernel (ops/attention.py:
+``_paged_flash`` + the shared impl dispatch).
+
+Pins: interpret-mode kernel output is allclose to the XLA gather path
+across ragged length mixes, page-size edge cases (empty slot, 1-token
+tail, exactly-full page, single-page request), GQA head ratios, and
+trash-page masking (pools poisoned at TRASH_PAGE); a full
+PagedDecodeEngine run retires BITWISE-identical token ids under
+``impl="xla"`` and ``impl="pallas_interpret"`` with zero leaked pages;
+the shared ``resolve_attention_impl`` helper's dispatch rules (unknown
+impl raises, ineligible explicit pallas downgrades to the gather path);
+and the DEC005 eligibility diagnostic fires exactly on geometries
+``paged_kernel_constraints`` rejects.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu import Cluster, get_scheduler
+from distributed_llm_scheduler_tpu.models.kv_pages import TRASH_PAGE, PagePool
+from distributed_llm_scheduler_tpu.ops.attention import (
+    paged_decode_attention,
+    paged_kernel_constraints,
+    paged_pallas_supported,
+    resolve_attention_impl,
+)
+
+
+def _paged_state(S, Hkv, hd, ps, ppseq, lengths, seed=0, poison=True):
+    """Random pools + a page table covering each slot's rows, with the
+    trash page poisoned so parity also proves the masking."""
+    rng = np.random.RandomState(seed)
+    n_pages = S * ppseq + 1
+    k_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+    v_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+    if poison:
+        k_pool = k_pool.at[TRASH_PAGE].set(1e9)
+        v_pool = v_pool.at[TRASH_PAGE].set(1e9)
+    pt = np.full((S, ppseq), TRASH_PAGE, np.int32)
+    page = 1
+    for s, L in enumerate(lengths):
+        # pages for the L cached rows plus this step's insert row
+        for j in range((min(L + 1, ppseq * ps) + ps - 1) // ps):
+            pt[s, j] = page
+            page += 1
+    return k_pool, v_pool, jnp.asarray(pt), jnp.asarray(lengths, jnp.int32)
+
+
+# (name, S, Hq, Hkv, hd, ps, ppseq, lengths, with_insert)
+FIXTURES = [
+    ("ragged_mix", 3, 4, 2, 8, 16, 4, [0, 5, 49], True),
+    ("no_insert", 3, 4, 2, 8, 16, 4, [1, 16, 31], False),
+    ("mha_heads", 2, 2, 2, 8, 16, 2, [15, 19], True),
+    ("gqa_4to1", 2, 8, 2, 16, 16, 2, [3, 30], True),
+    ("single_page_request", 2, 4, 2, 8, 16, 1, [1, 15], True),
+    ("one_token_and_empty", 2, 4, 2, 8, 16, 2, [1, 0], True),
+    ("exactly_full_pages", 2, 4, 2, 8, 16, 2, [16, 31], True),
+    ("capacity_minus_one", 2, 4, 2, 8, 16, 2, [31, 31], True),
+    ("small_pages_interpret", 3, 4, 2, 8, 4, 4, [0, 5, 15], True),
+]
+
+
+@pytest.mark.parametrize(
+    "name,S,Hq,Hkv,hd,ps,ppseq,lengths,with_insert",
+    FIXTURES, ids=[f[0] for f in FIXTURES],
+)
+def test_kernel_matches_gather(name, S, Hq, Hkv, hd, ps, ppseq, lengths,
+                               with_insert):
+    k_pool, v_pool, pt, L = _paged_state(S, Hkv, hd, ps, ppseq, lengths)
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(S, Hq, 1, hd), jnp.float32)
+    kn = vn = None
+    if with_insert:
+        kn = jnp.asarray(rng.randn(S, Hkv, 1, hd), jnp.float32)
+        vn = jnp.asarray(rng.randn(S, Hkv, 1, hd), jnp.float32)
+    scale = hd ** -0.5
+    ref = paged_decode_attention(
+        q, k_pool, v_pool, pt, L, scale, k_new=kn, v_new=vn, impl="xla"
+    )
+    got = paged_decode_attention(
+        q, k_pool, v_pool, pt, L, scale, k_new=kn, v_new=vn,
+        impl="pallas_interpret",
+    )
+    assert bool(jnp.all(jnp.isfinite(got))), f"{name}: non-finite output"
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5,
+        err_msg=f"{name}: kernel diverged from gather path",
+    )
+
+
+def test_kernel_masks_poisoned_trash_page():
+    """Flip the trash-page poison on and off: outputs must be bitwise
+    identical — the kernel's masked pages contribute exactly nothing."""
+    S, Hq, Hkv, hd, ps, ppseq = 2, 4, 2, 8, 16, 4
+    lengths = [3, 20]
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(S, Hq, 1, hd), jnp.float32)
+    outs = []
+    for poison in (False, True):
+        k_pool, v_pool, pt, L = _paged_state(
+            S, Hkv, hd, ps, ppseq, lengths, seed=2, poison=poison
+        )
+        outs.append(paged_decode_attention(
+            q, k_pool, v_pool, pt, L, hd ** -0.5, impl="pallas_interpret"
+        ))
+    np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(outs[1]))
+
+
+# -- shared impl dispatch ----------------------------------------------------
+
+def test_resolve_attention_impl_rules():
+    assert resolve_attention_impl("xla", lambda i: True) == "xla"
+    assert resolve_attention_impl(
+        "pallas_interpret", lambda i: True
+    ) == "pallas_interpret"
+    # ineligible explicit kernel request downgrades to the gather path
+    assert resolve_attention_impl("pallas", lambda i: False) == "xla"
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        resolve_attention_impl("cuda", lambda i: True)
+    # auto on a non-TPU host resolves to the gather path
+    if jax.default_backend() != "tpu":
+        assert resolve_attention_impl(None, lambda i: True) == "xla"
+        assert resolve_attention_impl("auto", lambda i: True) == "xla"
+
+
+def test_paged_kernel_constraints():
+    # the default engine geometry (ps=16, hd=8, f32) is eligible
+    assert paged_kernel_constraints(16, 8, 2) == []
+    # each violated constraint is named
+    bad_ps = paged_kernel_constraints(6, 8, 2)
+    assert len(bad_ps) == 1 and "page_size 6" in bad_ps[0]
+    bad_hd = paged_kernel_constraints(16, 12, 2)
+    assert len(bad_hd) == 1 and "head_dim 12" in bad_hd[0]
+    bad_gqa = paged_kernel_constraints(16, 8, 4, n_q_heads=6)
+    assert any("n_q_heads 6" in c for c in bad_gqa)
+    # bf16 pages tile at 16 rows, so ps=8 is ineligible there but f32
+    # (8-row sublanes) is fine
+    assert paged_kernel_constraints(8, 8, 2) == []
+    bad_bf16 = paged_kernel_constraints(8, 8, 2, dtype=jnp.bfloat16)
+    assert len(bad_bf16) == 1 and "16-row" in bad_bf16[0]
+
+
+def test_paged_pallas_supported_shapes():
+    q = (4, 4, 1, 8)
+    pool_ok = (64, 16, 2, 8)
+    try:
+        from jax.experimental.pallas import tpu as _  # noqa: F401
+    except ImportError:
+        pytest.skip("pltpu unavailable on this jax build")
+    assert paged_pallas_supported(q, pool_ok, interpret=True)
+    # interpret mode only needs structural validity, not lowering tiles
+    assert paged_pallas_supported(q, (64, 6, 2, 8), interpret=True)
+    assert not paged_pallas_supported(q, (64, 6, 2, 8), interpret=False)
+    # multi-token q / head mismatch are structurally unsupported
+    assert not paged_pallas_supported((4, 4, 2, 8), pool_ok, interpret=True)
+    assert not paged_pallas_supported((4, 3, 1, 8), (64, 16, 2, 8),
+                                      interpret=True)
+
+
+# -- engine-level bit-identity ----------------------------------------------
+
+def _build_engine(impl, slots=2, ps=8, n_pages=32, ppseq=4):
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    dag = build_paged_decode_dag(cfg, slots=slots, page_size=ps,
+                                 n_pages=n_pages, pages_per_seq=ppseq,
+                                 attention_impl=impl)
+    params = dag.init_params()
+    weights = {k: v for k, v in params.items()
+               if not (k.startswith("cache_") or k == "page_table")}
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+    eng = DeviceBackend(cluster).paged_decode_engine(
+        dag.graph, sched, cfg, weights, pool,
+        slots=slots, pages_per_seq=ppseq, seg_steps=4,
+        attention_impl=impl,
+    )
+    return eng, pool, cfg
+
+
+def test_engine_tokens_bitwise_identical_across_impls():
+    """Same churny workload through two engines differing only in
+    attention impl: retired token ids must match bitwise, and both
+    pools must come back whole."""
+    results = {}
+    pools = {}
+    for impl in ("xla", "pallas_interpret"):
+        eng, pool, cfg = _build_engine(impl)
+        rng = np.random.RandomState(11)
+        for i in range(5):
+            P = [8, 16, 8][i % 3]
+            gen = [10, 5, 1][i % 3]
+            ids = jnp.asarray(
+                rng.randint(0, cfg.vocab_size, (1, P)), jnp.int32
+            )
+            eng.submit(f"r{i}", ids, gen)
+        results[impl] = eng.run()
+        pools[impl] = pool
+        assert eng.summary()["attention_impl"] == impl
+    assert set(results["xla"]) == set(results["pallas_interpret"])
+    for rid in results["xla"]:
+        np.testing.assert_array_equal(
+            np.asarray(results["xla"][rid]),
+            np.asarray(results["pallas_interpret"][rid]),
+            err_msg=f"{rid}: tokens diverge between impls",
+        )
+    for impl, pool in pools.items():
+        assert pool.free_pages == pool.n_pages - 1, f"{impl} leaked pages"
+
+
+def test_dag_names_distinguish_impls():
+    """The impl is part of the graph identity: explicit impls get a
+    name suffix, the default stays byte-stable for schedule caches."""
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    base = build_paged_decode_dag(cfg, slots=2)
+    forced = build_paged_decode_dag(cfg, slots=2, attention_impl="xla")
+    assert base.graph.name != forced.graph.name
+    assert forced.graph.name.endswith("_attxla")
+    assert base.attention_impl is None
+    assert forced.graph.attention_impl == "xla"
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        build_paged_decode_dag(cfg, slots=2, attention_impl="nope")
+
+
+# -- DEC005 eligibility diagnostic ------------------------------------------
+
+def _paged_specs(page_size, hd, n_kv=2, dtype=jnp.float32):
+    return {
+        "cache_k_0": jax.ShapeDtypeStruct((8, page_size, n_kv, hd), dtype),
+        "cache_v_0": jax.ShapeDtypeStruct((8, page_size, n_kv, hd), dtype),
+        "page_table": jax.ShapeDtypeStruct((2, 4), jnp.int32),
+    }
+
+
+def test_dec005_fires_on_ineligible_geometry():
+    from distributed_llm_scheduler_tpu.analysis import analyze
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    dag = build_paged_decode_dag(cfg, slots=2, page_size=6)
+    rep = analyze(dag.graph, params=dag.param_specs)
+    dec5 = [d for d in rep.diagnostics if d.code == "DEC005"]
+    assert len(dec5) == 1
+    assert dec5[0].severity.name == "WARNING"
+    assert "page_size 6" in dec5[0].message
+    # a warning, never a gate: exit code stays 0
+    assert rep.exit_code == 0
+
+
+def test_dec005_silent_on_default_geometry_and_without_specs():
+    from distributed_llm_scheduler_tpu.analysis import analyze
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    dag = build_paged_decode_dag(cfg, slots=2)  # default ps=16, hd=8
+    rep = analyze(dag.graph, params=dag.param_specs)
+    assert not rep.has("DEC005")
+    # no specs -> the pass cannot judge geometry, stays silent
+    ineligible = build_paged_decode_dag(cfg, slots=2, page_size=6)
+    rep2 = analyze(ineligible.graph)
+    assert not rep2.has("DEC005")

@@ -33,6 +33,11 @@ BASE = {
     "serve.goodput_tok_s": 200.0,
     "serve.ttft_p99_ms": 130.0,
     "serve.queue_wait_p95_ms": 120.0,
+    "decode.paged_tokens_exact": True,
+    "decode.pages_leaked": 0,
+    "decode.kernel_tokens_exact": True,
+    "decode.kernel_parity_ok": True,
+    "decode.kernel_pages_leaked": 0,
 }
 
 
