@@ -546,8 +546,13 @@ def measure(
     schedules = {}
     for name in sorted(ALL_SCHEDULERS):
         # link-aware policies optimize the replay's objective: same link
-        # (get_scheduler hands `link` to any policy whose ctor accepts it)
-        sched = get_scheduler(name, link=link)
+        # (get_scheduler hands `link` to any policy whose ctor accepts it).
+        # The annealed search runs a reduced eval budget here: at its
+        # default 800 it alone would eat minutes of the watchdog budget,
+        # and its full-budget margin is banked by the dedicated
+        # eval/search_bench.py gate (SEARCH_r15.json), not this loop.
+        kw = {"budget": 120} if name == "search" else {}
+        sched = get_scheduler(name, link=link, **kw)
         s = sched.schedule(graph, cluster)
         r = sim.execute(graph, cluster, s, dag_type=dag_type)
         completion = r.completed_tasks / r.num_tasks

@@ -59,6 +59,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--hbm-gb", type=float, default=14.0)
     p.add_argument("--memory-regime", type=float, default=1.0)
     p.add_argument("--scheduler", default="heft")
+    p.add_argument("--search-budget", type=int, default=None,
+                   dest="search_budget",
+                   help="--scheduler search: evaluation budget for the "
+                        "annealed placement search (default 800)")
+    p.add_argument("--search-seed", type=int, default=None,
+                   dest="search_seed",
+                   help="--scheduler search: RNG seed; same seed + "
+                        "budget reproduces the placement digest exactly")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out-dir", default="evaluation_results")
 

@@ -52,6 +52,11 @@ LOWER_BETTER = (
     # paged decode legs: any leaked page is an engine bug
     "decode.pages_leaked",
     "decode.kernel_pages_leaked",
+    # searched-placement bench (dls search_bench artifact): simulated
+    # makespans, deterministic given seed + budget
+    "search.makespan_ms",
+    "search.replay_ms",
+    "search.best_hand_replay_ms",
 )
 
 # lower-is-better metric FAMILIES, matched by prefix: per-device peak
@@ -88,6 +93,10 @@ METRIC_DEFAULT_TOLERANCES = {
     "decode.paged_tok_s": 0.35,
     "decode.paged_speedup": 0.35,
     "decode.kernel_vs_gather_speedup": 0.35,
+    # search bench legs are seeded simulation end to end — placements,
+    # makespans, and margins are pure functions of (seed, budget), so
+    # any drift is a behavior change, not noise (family-wide)
+    "search": 0.0,
 }
 HIGHER_BETTER = (
     "vs_baseline",
@@ -99,12 +108,17 @@ HIGHER_BETTER = (
     "decode.paged_tok_s",
     "decode.paged_speedup",
     "decode.kernel_vs_gather_speedup",
+    "search.margin_vs_hand_pct",
+    "search.ici_slow_margin_pct",
+    "search.ici_fast_margin_pct",
 )
 BOOL_METRICS = (
     "oracle_ok",
     "decode.paged_tokens_exact",
     "decode.kernel_tokens_exact",
     "decode.kernel_parity_ok",
+    "search.beats_hand",
+    "search.beats_ici_extreme",
 )
 
 # the default comparison set: quality metrics only — environment
@@ -130,6 +144,17 @@ DEFAULT_METRICS = (
     "decode.kernel_tokens_exact",
     "decode.kernel_parity_ok",
     "decode.kernel_pages_leaked",
+    "search.makespan_ms",
+    "search.replay_ms",
+    "search.margin_vs_hand_pct",
+    "search.ici_slow_margin_pct",
+    "search.ici_fast_margin_pct",
+    "search.beats_hand",
+    "search.beats_ici_extreme",
+    # the digest is a string: zero-tolerance equality via the
+    # non-numeric branch — same seed + budget must reproduce the
+    # placement bit-for-bit across machines and processes
+    "search.placement_digest",
 )
 
 DEFAULT_TOLERANCE = 0.10

@@ -146,11 +146,17 @@ def run_serving_leg(
 
 
 def measure_serving(seed: int = 7,
-                    scenario: Optional[Dict[str, Any]] = None
+                    scenario: Optional[Dict[str, Any]] = None,
+                    engine: Optional[Any] = None,
                     ) -> Dict[str, Any]:
     """The full comparison: fifo admit-all vs slo+preemption on the
     same arrival schedule, plus a same-seed determinism repeat of the
-    slo leg.  Returns the ``dls.serve/1`` artifact dict."""
+    slo leg.  Returns the ``dls.serve/1`` artifact dict.
+
+    ``engine`` (test seam) reuses an already-compiled engine instead of
+    building one; the caller must have rebound it to a fresh
+    ``VirtualClock`` (``rebind_obs``) and its geometry must match the
+    scenario's — only the default SCENARIO geometry qualifies."""
     from ..obs.slo import SLOPolicy
     from ..serve.frontend import ServiceTimeModel
     from ..serve.loadgen import poisson_arrivals, schedule_digest
@@ -173,11 +179,14 @@ def measure_serving(seed: int = 7,
     )
     from ..serve.frontend import VirtualClock
 
-    eng, _pool = build_serve_engine(
-        slots=sc["slots"], page_size=sc["page_size"],
-        n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
-        seg_steps=sc["seg_steps"], clock=VirtualClock(),
-    )
+    if engine is not None:
+        eng = engine
+    else:
+        eng, _pool = build_serve_engine(
+            slots=sc["slots"], page_size=sc["page_size"],
+            n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
+            seg_steps=sc["seg_steps"], clock=VirtualClock(),
+        )
     fifo = run_serving_leg(arrivals, policy, "fifo", False, tm, sc,
                            engine=eng)
     slo = run_serving_leg(arrivals, policy, "slo", True, tm, sc,

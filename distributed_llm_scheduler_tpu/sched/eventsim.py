@@ -28,12 +28,30 @@ time, the convention HEFT's insertion pass uses (sched/heft.py).
 from __future__ import annotations
 
 import heapq
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..backends.sim import LinkModel
 from ..core.graph import TaskGraph
 
 _EPS = 1e-12
+
+
+@dataclass
+class PlacementTimeline:
+    """Full event-sim outcome for one placement.
+
+    ``simulate_placement`` keeps its historical ``(order, makespan,
+    node_finish)`` triple; the search tier (:mod:`.search`) additionally
+    needs per-task ``start_at``/``finish`` to walk the simulated critical
+    path, so the timeline is exposed whole here.
+    """
+
+    order: List[str] = field(default_factory=list)
+    makespan: float = 0.0
+    node_finish: Dict[str, float] = field(default_factory=dict)
+    start_at: Dict[str, float] = field(default_factory=dict)
+    finish: Dict[str, float] = field(default_factory=dict)
 
 
 def dependency_aware_order(
@@ -80,6 +98,21 @@ def simulate_placement(
     hill-climbs on, using exactly the cost model the ordering pass and the
     replay charge (so the search can't optimize a different fiction).
     """
+    tl = simulate_placement_timeline(graph, placement, speeds, link, slices)
+    return tl.order, tl.makespan, tl.node_finish
+
+
+def simulate_placement_timeline(
+    graph: TaskGraph,
+    placement: Dict[str, str],
+    speeds: Optional[Dict[str, float]] = None,
+    link: Optional[LinkModel] = None,
+    slices: Optional[Dict[str, int]] = None,
+) -> PlacementTimeline:
+    """:func:`simulate_placement` with the per-task times kept: the
+    annealed search (:mod:`.search`) walks ``start_at``/``finish``
+    backward to find the simulated critical path its move proposals are
+    biased toward."""
     link = link or LinkModel()
     speeds = speeds or {}
     slices = slices or {}
@@ -190,4 +223,10 @@ def simulate_placement(
         nid = placement[tid]
         node_finish[nid] = max(node_finish[nid], f)
     makespan = max(node_finish.values(), default=0.0)
-    return order, makespan, node_finish
+    return PlacementTimeline(
+        order=order,
+        makespan=makespan,
+        node_finish=node_finish,
+        start_at=start_at,
+        finish=finish,
+    )

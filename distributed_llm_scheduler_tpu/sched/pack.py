@@ -100,7 +100,10 @@ class GroupPackScheduler(BaseScheduler):
             if any(d in run.failed for d in task.dependencies):
                 self.fail(run, task)
                 continue
-            d = placed.get(task.group or tid)
+            # `placed` may be keyed by group (pack/refine plans) or by
+            # task id (the search tier's task-level placements); a task
+            # key always wins so search can split groups across devices
+            d = placed.get(tid, placed.get(task.group or tid))
             if d is not None and self.can_fit(run, task, devices[d]):
                 self.assign(run, task, devices[d])
                 continue

@@ -281,6 +281,7 @@ from .heft import HEFTScheduler  # noqa: E402  (avoids a circular import)
 from .pack import GroupPackScheduler  # noqa: E402
 from .pipeline import PipelineStageScheduler  # noqa: E402
 from .refine import RefinedPackScheduler  # noqa: E402
+from .search import SearchScheduler  # noqa: E402
 
 ALL_SCHEDULERS = {
     cls.name: cls
@@ -294,11 +295,12 @@ ALL_SCHEDULERS = {
         PipelineStageScheduler,
         GroupPackScheduler,
         RefinedPackScheduler,
+        SearchScheduler,
     )
 }
 
 
-def get_scheduler(name: str, link=None) -> BaseScheduler:
+def get_scheduler(name: str, link=None, **kwargs) -> BaseScheduler:
     """Policy by name.  ``"native:<policy>"`` selects the C++ engine
     explicitly; ``DLS_NATIVE=1`` upgrades every natively-supported policy
     transparently (parity-tested: identical schedules, faster wall time).
@@ -309,6 +311,10 @@ def get_scheduler(name: str, link=None) -> BaseScheduler:
     with a tiered link raises (the C ABI is flat-link only); the
     ``DLS_NATIVE=1`` transparent upgrade instead falls back to the Python
     policy so the tiered costs are honored.
+
+    Extra ``kwargs`` (e.g. ``budget``/``seed`` for the search tier) are
+    forwarded only to policies whose constructor declares them, so one
+    call site can configure the whole registry uniformly.
     """
     import inspect
     import os
@@ -331,6 +337,10 @@ def get_scheduler(name: str, link=None) -> BaseScheduler:
         if name in native_mod.POLICY_IDS and native_mod.available():
             return NativeScheduler(name, link=link)
     cls = ALL_SCHEDULERS[name]
-    if link is not None and "link" in inspect.signature(cls.__init__).parameters:
-        return cls(link=link)
-    return cls()
+    params = inspect.signature(cls.__init__).parameters
+    accepted = {
+        k: v for k, v in kwargs.items() if k in params and v is not None
+    }
+    if link is not None and "link" in params:
+        accepted["link"] = link
+    return cls(**accepted)

@@ -140,10 +140,12 @@ class RefinedPackScheduler(GroupPackScheduler):
         link: Optional[LinkModel] = None,
         max_evals: int = 400,
         tol: float = 1e-9,
+        seed: int = 0,
     ):
         super().__init__(link=link)
         self.max_evals = max_evals
         self.tol = tol
+        self.seed = seed
 
     def run_policy(self, run: SchedulerRun) -> None:
         graph, devices = run.graph, run.cluster.devices
@@ -271,10 +273,10 @@ class RefinedPackScheduler(GroupPackScheduler):
 
         # basin hopping: hill climbing converges in tens of evals; spend
         # the remaining budget escaping its local optimum — perturb the
-        # incumbent by a few random feasible group moves (seeded RNG:
-        # deterministic across runs and processes) and re-climb, keeping
-        # the global best
-        rng = random.Random(0)
+        # incumbent by a few random feasible group moves (explicit seed:
+        # same-seed placements are bitwise reproducible cross-process)
+        # and re-climb, keeping the global best
+        rng = random.Random(self.seed)
         glist = sorted(best)
         stale = 0  # consecutive failures to produce any feasible change
         while evals + 2 < self.max_evals and glist and stale < 10:

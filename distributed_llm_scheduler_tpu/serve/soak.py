@@ -164,6 +164,7 @@ def run_soak(
     instrument: bool = True,
     inject_leak_every: Optional[int] = None,
     inject_churn: bool = False,
+    engine_factory: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Run one duration-bounded soak; returns the ``dls.soak/1`` dict.
 
@@ -171,6 +172,12 @@ def run_soak(
     sampler, flight recorder, or health evaluation — the bare leg of
     the bit-identity gate.  The injectors are test/CI-only and recorded
     in the artifact's ``injection`` block.
+
+    ``engine_factory`` (test seam) supplies the engine instead of
+    building one: called as ``engine_factory(clock=..., flight=...,
+    attention_impl=...)`` and expected to hand back an engine already
+    rebound to those surfaces (``PagedDecodeEngine.rebind_obs``) — how
+    the test suite shares one compiled engine across every soak leg.
     """
     from ..obs import FlightRecorder, HealthMonitor, SoakSampler, \
         TimeSeriesStore
@@ -187,13 +194,18 @@ def run_soak(
     )
     from ..eval.serve_bench import SCENARIO, build_serve_engine
 
-    eng, _pool = build_serve_engine(
-        slots=SCENARIO["slots"], page_size=SCENARIO["page_size"],
-        n_pages=SCENARIO["n_pages"],
-        pages_per_seq=SCENARIO["pages_per_seq"],
-        seg_steps=SCENARIO["seg_steps"], clock=clock, flight=flight,
-        attention_impl=cfg.attention_impl,
-    )
+    if engine_factory is not None:
+        eng = engine_factory(
+            clock=clock, flight=flight, attention_impl=cfg.attention_impl
+        )
+    else:
+        eng, _pool = build_serve_engine(
+            slots=SCENARIO["slots"], page_size=SCENARIO["page_size"],
+            n_pages=SCENARIO["n_pages"],
+            pages_per_seq=SCENARIO["pages_per_seq"],
+            seg_steps=SCENARIO["seg_steps"], clock=clock, flight=flight,
+            attention_impl=cfg.attention_impl,
+        )
     injection: Dict[str, Any] = {}
     if inject_leak_every is not None:
         inject_page_leak(eng, every=inject_leak_every)

@@ -39,6 +39,12 @@ class RunConfig:
 
     # scheduling
     scheduler: str = "heft"
+    # search-tier knobs (``--scheduler search``): eval budget and RNG
+    # seed for the annealed placement search.  None keeps the policy's
+    # own defaults; other policies ignore them (get_scheduler forwards
+    # kwargs only to constructors that declare them)
+    search_budget: Optional[int] = None
+    search_seed: Optional[int] = None
 
     # backend
     backend: str = "sim"           # sim | sim-reference | device
@@ -249,7 +255,10 @@ class RunConfig:
         ``link=`` keyword), so multi-slice runs optimize DCN-aware costs."""
         from ..sched.policies import get_scheduler
 
-        return get_scheduler(self.scheduler, link=self.build_link())
+        return get_scheduler(
+            self.scheduler, link=self.build_link(),
+            budget=self.search_budget, seed=self.search_seed,
+        )
 
     def build_backend(self):
         from ..backends.sim import SimulatedBackend

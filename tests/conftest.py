@@ -51,3 +51,41 @@ def two_nodes() -> Cluster:
     return Cluster(
         [DeviceState("node_0", 3.0, 1.0), DeviceState("node_1", 2.5, 1.2)]
     )
+
+
+@pytest.fixture(scope="session")
+def session_serve_engine():
+    """ONE compiled bench-scenario serving engine for the whole session.
+
+    Building a ``PagedDecodeEngine`` pays DAG construction, scheduling,
+    and XLA compilation (~seconds); every engine the serve/soak tests
+    need has the same SCENARIO geometry, so they share this instance and
+    re-point it at their own clock/flight via
+    ``PagedDecodeEngine.rebind_obs`` — warm executables, clean state."""
+    from distributed_llm_scheduler_tpu.eval import serve_bench
+    from distributed_llm_scheduler_tpu.serve.frontend import VirtualClock
+
+    eng, _pool = serve_bench.build_serve_engine(clock=VirtualClock())
+    return eng
+
+
+@pytest.fixture(scope="session")
+def serve_engine_factory(session_serve_engine):
+    """``run_soak(engine_factory=...)``-shaped seam over the session
+    engine: rebinds obs per leg; a non-default attention impl changes
+    the compiled graph itself, so that (rare) case builds fresh."""
+
+    def factory(*, clock=None, flight=None, attention_impl=None):
+        eng = session_serve_engine
+        if (attention_impl is not None
+                and attention_impl != eng.attention_impl):
+            from distributed_llm_scheduler_tpu.eval import serve_bench
+
+            fresh, _pool = serve_bench.build_serve_engine(
+                clock=clock, flight=flight, attention_impl=attention_impl
+            )
+            return fresh
+        eng.rebind_obs(clock=clock, flight=flight)
+        return eng
+
+    return factory

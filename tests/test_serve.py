@@ -113,22 +113,17 @@ def test_trace_roundtrip_and_validation(tmp_path):
 
 
 # -- engine: duplicate rids, occupancy, preemption -------------------------
-@pytest.fixture(scope="module")
-def shared_engine():
-    """One warmed bench-scenario engine shared across the engine tests;
-    each test gets it freshly reset (compiled programs kept, clock
-    rewound to 0) — the same clean-slate contract serve_bench leans on."""
-    eng, pool = serve_bench.build_serve_engine(clock=VirtualClock())
-    return eng, pool
-
-
 @pytest.fixture()
-def _engine(shared_engine):
+def _engine(session_serve_engine):
+    """Each test gets the session engine rebound to a fresh VirtualClock
+    and a pristine pool (compiled programs kept) — the same clean-slate
+    contract serve_bench leans on.  ``eng.pool`` is re-read after the
+    rebind because rebind_obs swaps the pool object."""
+
     def fresh():
-        eng, pool = shared_engine
-        eng.reset()
-        eng._clock.reset()
-        return eng, pool
+        eng = session_serve_engine
+        eng.rebind_obs(clock=VirtualClock())
+        return eng, eng.pool
 
     return fresh
 
@@ -224,8 +219,10 @@ def test_preempt_requires_in_flight(_engine):
 
 # -- frontend + bench: the fifo-vs-slo comparison --------------------------
 @pytest.fixture(scope="module")
-def serve_artifact():
-    return serve_bench.measure_serving(seed=7)
+def serve_artifact(session_serve_engine):
+    eng = session_serve_engine
+    eng.rebind_obs(clock=VirtualClock())
+    return serve_bench.measure_serving(seed=7, engine=eng)
 
 
 def test_slo_admission_beats_fifo_under_overload(serve_artifact):

@@ -38,15 +38,16 @@ from distributed_llm_scheduler_tpu.serve.soak import (  # noqa: E402
 
 # -- shared soak runs (each costs a few wall seconds; run once) ------------
 @pytest.fixture(scope="module")
-def healthy_art():
-    return run_soak(SoakConfig())
+def healthy_art(serve_engine_factory):
+    return run_soak(SoakConfig(), engine_factory=serve_engine_factory)
 
 
 @pytest.fixture(scope="module")
-def leak_art(tmp_path_factory):
+def leak_art(tmp_path_factory, serve_engine_factory):
     fdir = tmp_path_factory.mktemp("flight")
     return run_soak(SoakConfig(), flight_dir=str(fdir),
-                    inject_leak_every=2)
+                    inject_leak_every=2,
+                    engine_factory=serve_engine_factory)
 
 
 # -- Theil-Sen -------------------------------------------------------------
@@ -215,8 +216,9 @@ def test_injected_page_leak_trips_hlt001(leak_art):
     assert any("HLT001" in r for r in reasons), reasons
 
 
-def test_injected_jit_churn_trips_hlt003():
-    art = run_soak(SoakConfig(), inject_churn=True)
+def test_injected_jit_churn_trips_hlt003(serve_engine_factory):
+    art = run_soak(SoakConfig(), inject_churn=True,
+                   engine_factory=serve_engine_factory)
     assert art["verdict"] == "breach"
     breaches = {f["code"] for f in art["health"]["findings"]
                 if f["severity"] == "error"}
@@ -240,17 +242,21 @@ def test_healthy_soak_artifact(healthy_art):
         assert len(row["points"]) <= art["timeseries"]["capacity"], name
 
 
-def test_instrumented_soak_bit_identical_to_bare(healthy_art):
+def test_instrumented_soak_bit_identical_to_bare(healthy_art,
+                                                 serve_engine_factory):
     """Sampling only reads; the served-token digest of an instrumented
-    soak must equal an un-instrumented same-seed run exactly."""
-    bare = run_soak(SoakConfig(), instrument=False)
+    soak must equal an un-instrumented same-seed run exactly — engine
+    reuse included: the bare leg runs on the SAME rebound engine the
+    instrumented one used."""
+    bare = run_soak(SoakConfig(), instrument=False,
+                    engine_factory=serve_engine_factory)
     assert "timeseries" not in bare
     assert bare["digest"] == healthy_art["digest"]
     assert bare["serving"] == healthy_art["serving"]
 
 
-def test_soak_deterministic_same_seed(healthy_art):
-    again = run_soak(SoakConfig())
+def test_soak_deterministic_same_seed(healthy_art, serve_engine_factory):
+    again = run_soak(SoakConfig(), engine_factory=serve_engine_factory)
     assert again == healthy_art
 
 
@@ -276,7 +282,7 @@ def test_report_from_soak_artifact_regates(healthy_art, leak_art):
         report_from_soak_artifact({"schema": "nope"})
 
 
-def test_real_clock_soak_smoke():
+def test_real_clock_soak_smoke(serve_engine_factory):
     """~2s against the actual wall clock: timestamps strictly monotone,
     zero leaked pages, schema-valid artifact.  The health VERDICT is
     not asserted — wall time on a shared test machine is allowed to be
@@ -285,7 +291,7 @@ def test_real_clock_soak_smoke():
     art = run_soak(SoakConfig(
         duration_s=2.0, warmup_s=1.0, rate_rps=2.0, ttft_s=2.0,
         window_s=1.0, real_clock=True,
-    ))
+    ), engine_factory=serve_engine_factory)
     assert validate_soak_artifact(art) == []
     assert art["clock"] == "wall"
     assert art["serving"]["pages_leaked"] == 0
