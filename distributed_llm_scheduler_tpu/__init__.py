@@ -19,14 +19,14 @@ scheduling → execution → evaluation/visualization), rebuilt TPU-first:
 See SURVEY.md for the layer map and parity notes.
 """
 
-import os as _os
+from .utils.config import env_str as _env_str
 
 # DLS_PLATFORM=cpu|tpu pins the JAX platform before the first backend touch
 # (e.g. to keep CLI/dev runs on the host when no accelerator is reachable);
 # DLS_FORCE_CPU=1 is shorthand for DLS_PLATFORM=cpu.  Must run before
 # anything resolves a backend; importing this package first is enough.
-_plat = _os.environ.get("DLS_PLATFORM") or (
-    "cpu" if _os.environ.get("DLS_FORCE_CPU") else None
+_plat = _env_str("DLS_PLATFORM") or (
+    "cpu" if _env_str("DLS_FORCE_CPU") else None
 )
 if _plat:
     import jax as _jax

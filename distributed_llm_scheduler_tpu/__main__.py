@@ -181,12 +181,108 @@ def cmd_schedule(args) -> int:
     return 0
 
 
+def _cmd_lint_serving(args) -> int:
+    """The serving half of the lint (``lint --serving``): run the
+    serve_bench scenario with the page-ownership seam attached, then
+    the three serving-safety passes — the page-lifetime prover
+    (PGL00x) over the recorded event stream, the request-lifecycle
+    checker (LCY00x) over both the frontend's rows and the engine's
+    reqlog, and the repo-wide determinism lint (DET00x).
+    ``--inject-leak N`` swaps in the leaky-pool fault injector (the CI
+    must-fail leg: exit 1 naming PGL001)."""
+    from .analysis import (
+        Severity,
+        analyze_determinism,
+        analyze_lifecycle,
+        analyze_pages,
+    )
+    from .eval.serve_bench import SCENARIO, build_serve_engine
+    from .models.kv_pages import PageOwnershipLog
+    from .obs.slo import SLOPolicy
+    from .serve.frontend import (
+        ServiceTimeModel,
+        ServingFrontend,
+        VirtualClock,
+    )
+    from .serve.loadgen import poisson_arrivals
+    from .serve.soak import inject_page_leak
+
+    if args.inject_leak is not None and args.inject_leak < 1:
+        print(f"--inject-leak must be >= 1, got {args.inject_leak}",
+              file=sys.stderr)
+        return 2
+    sc = SCENARIO
+    arrivals = poisson_arrivals(
+        sc["rate_rps"], sc["n_requests"], args.seed,
+        prompt_lens=sc["prompt_lens"],
+        max_new_tokens=sc["max_new_tokens"],
+        priorities=sc["priorities"],
+        priority_weights=sc["priority_weights"],
+    )
+    eng, _pool = build_serve_engine(
+        slots=sc["slots"], page_size=sc["page_size"],
+        n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
+        seg_steps=sc["seg_steps"], clock=VirtualClock(),
+    )
+    ownlog = PageOwnershipLog()
+    eng.attach_ownership_log(ownlog)
+    if args.inject_leak is not None:
+        inject_page_leak(eng, args.inject_leak)
+    fe = ServingFrontend(
+        eng, arrivals,
+        SLOPolicy(ttft_s=sc["ttft_s"], window_s=sc["window_s"],
+                  percentile=sc["percentile"]),
+        admission="slo", preemption=True,
+        time_model=ServiceTimeModel(
+            wave_s=sc["wave_s"], segment_s=sc["segment_s"],
+            idle_s=sc["idle_s"],
+        ),
+    )
+    fe.run()
+    rep = analyze_determinism()
+    rep.extend(analyze_pages(ownlog))
+    rep.extend(analyze_lifecycle(fe.request_rows(), final=True,
+                                 label="serving"))
+    rep.extend(analyze_lifecycle(eng.reqlog.snapshot(), final=True,
+                                 label="engine"))
+    rep = rep.dedupe()
+    if args.json:
+        print(json.dumps(rep.to_json()))
+        return rep.exit_code
+    min_sev = Severity.INFO if args.verbose else Severity.WARNING
+    print(rep.render(min_severity=min_sev))
+    if not rep.diagnostics:
+        n_pool = sum(
+            1 for e in ownlog.events if e["kind"] in ("alloc", "free")
+        )
+        print(
+            f"serving lint clean: {len(ownlog)} ownership events "
+            f"replayed, free+used tiling proven at all {n_pool} pool "
+            "events; lifecycle and determinism passes found nothing",
+            file=sys.stderr,
+        )
+    return rep.exit_code
+
+
 def cmd_lint(args) -> int:
     """Static analysis (analysis/): build the DAG, schedule it, and lint
     graph + schedule + memory + sharding + quantization without executing
     anything.  Exit 1 on errors, 0 otherwise."""
     from .analysis import _spec_shapes, analyze
     from .parallel.mesh import factorize_mesh
+
+    if getattr(args, "serving", False):
+        if args.parallel or args.decode or args.paged or args.preflight \
+                or args.fix:
+            print("--serving runs the serving-safety passes and combines "
+                  "only with --json/--verbose/--inject-leak/--seed",
+                  file=sys.stderr)
+            return 2
+        return _cmd_lint_serving(args)
+    if getattr(args, "inject_leak", None) is not None:
+        print("--inject-leak only applies to lint --serving",
+              file=sys.stderr)
+        return 2
 
     if args.parallel:
         if args.decode or args.paged or args.preflight or args.fix:
@@ -1577,6 +1673,8 @@ def cmd_doctor(args) -> int:
         return _cmd_doctor_slo(args)
     if getattr(args, "soak", None):
         return _cmd_doctor_soak(args)
+    if getattr(args, "serve", None):
+        return _cmd_doctor_serve(args)
     if args.trace:
         try:
             att = attribute_trace(args.trace)
@@ -1760,6 +1858,60 @@ def _cmd_doctor_soak(args) -> int:
     return 0
 
 
+def _cmd_doctor_serve(args) -> int:
+    """The serving-safety half of the doctor (``doctor --serve
+    ART_JSON``): re-gate a committed ``dls.serve/1`` or ``dls.soak/1``
+    artifact offline through the page-lifetime and request-lifecycle
+    passes — leaked-page gauges become PGL001 errors, embedded
+    ownership-event streams are replayed page by page, and per-request
+    rows are protocol-checked.  Exit 2 malformed/unknown schema, 1 when
+    any pass errors, 0 clean — mirroring ``doctor --soak``."""
+    from .analysis import analyze_serve_artifact
+    from .eval.serve_bench import validate_serve_artifact
+    from .serve.soak import validate_soak_artifact
+
+    try:
+        with open(args.serve) as f:
+            art = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"doctor --serve: {e}", file=sys.stderr)
+        return 2
+    schema = art.get("schema") if isinstance(art, dict) else None
+    if schema == "dls.serve/1":
+        problems = validate_serve_artifact(art)
+    elif schema == "dls.soak/1":
+        problems = validate_soak_artifact(art)
+    else:
+        print(f"doctor --serve: unknown artifact schema {schema!r} "
+              "(want dls.serve/1 or dls.soak/1)", file=sys.stderr)
+        return 2
+    if problems:
+        for p in problems:
+            print(f"doctor --serve: {p}", file=sys.stderr)
+        return 2
+    try:
+        rep = analyze_serve_artifact(art).dedupe()
+    except ValueError as e:
+        print(f"doctor --serve: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(
+        {
+            "serve": {
+                "schema": schema,
+                "seed": art.get("seed"),
+                "clock": art.get("clock"),
+            },
+            "lint": rep.to_json(),
+        },
+        indent=1,
+    ))
+    if rep.errors:
+        d = rep.errors[0]
+        print(f"doctor: {d.code}: {d.message}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_metrics_diff(args) -> int:
     """``metrics diff A B``: counter/gauge deltas and histogram quantile
     shifts between two ``dls.metrics/1`` snapshots — or, with
@@ -1903,6 +2055,18 @@ def main(argv=None) -> int:
                         "(parallel/*) and check collective ordering "
                         "(COL003/COL004/COL008) plus the MPMD "
                         "happens-before self-check (COL005-COL007)")
+    p.add_argument("--serving", action="store_true",
+                   help="run the serving-safety passes instead of a DAG: "
+                        "page-lifetime prover (PGL00x) over an "
+                        "ownership-instrumented serve_bench scenario, "
+                        "request-lifecycle checker (LCY00x) over frontend "
+                        "+ engine logs, repo-wide determinism lint "
+                        "(DET00x)")
+    p.add_argument("--inject-leak", type=int, default=None,
+                   dest="inject_leak", metavar="N",
+                   help="with --serving: withhold one page from every "
+                        "Nth free (the leaky-pool fault injector) — the "
+                        "prover must exit 1 naming PGL001")
     p.add_argument("--decode", action="store_true",
                    help="lint the single-token decode-step DAG instead of "
                         "the full forward")
@@ -2293,6 +2457,12 @@ def main(argv=None) -> int:
                         "artifact offline — rebuild its timeseries and "
                         "re-run the leak/degradation detector battery "
                         "(exit 1 on breach, 2 malformed)")
+    p.add_argument("--serve", default=None, metavar="ART_JSON",
+                   help="serving-safety doctor: re-gate a committed "
+                        "dls.serve/1 or dls.soak/1 artifact offline "
+                        "through the page-lifetime (PGL00x) and "
+                        "request-lifecycle (LCY00x) passes (exit 1 on "
+                        "findings, 2 malformed)")
     p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(

@@ -17,7 +17,6 @@ docs/ANALYSIS.md for the full taxonomy.  Entry points:
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 from ..core.cluster import Cluster
@@ -38,9 +37,12 @@ from .collective_pass import (
 )
 from .cost_pass import analyze_cost
 from .decode_pass import analyze_decode
+from .determinism_pass import analyze_determinism
 from .donation_pass import analyze_donation
 from .fixes import fix_duplicate_dependencies, fix_per_node_order
 from .graph_pass import analyze_graph
+from .lifecycle_pass import analyze_lifecycle
+from .page_pass import analyze_pages, analyze_serve_artifact
 from .hb_pass import StageOp, analyze_happens_before, stage_programs_1f1b
 from .incremental import AnalysisDelta, IncrementalAnalyzer
 from .memory_pass import analyze_memory, node_memory_slice
@@ -71,9 +73,13 @@ __all__ = [
     "analyze_collectives_jaxpr",
     "analyze_cost",
     "analyze_decode",
+    "analyze_determinism",
     "analyze_donation",
     "analyze_happens_before",
+    "analyze_lifecycle",
+    "analyze_pages",
     "analyze_schedule_lowerability",
+    "analyze_serve_artifact",
     "analyze_graph",
     "analyze_memory",
     "analyze_pipeline",
@@ -99,7 +105,9 @@ SKIP_ENV = "DLS_SKIP_ANALYSIS"
 
 
 def gate_enabled() -> bool:
-    return os.environ.get(SKIP_ENV, "0") in ("", "0")
+    from ..utils.config import env_str
+
+    return env_str(SKIP_ENV, "0") in ("", "0")
 
 
 def analyze(
@@ -119,6 +127,9 @@ def analyze(
     plan: Optional[Any] = None,
     params: Optional[Dict[str, Any]] = None,
     graph_input: Any = None,
+    page_events: Any = None,
+    request_log: Any = None,
+    request_log_final: bool = False,
 ) -> AnalysisReport:
     """Run every pass the provided inputs make applicable.
 
@@ -135,7 +146,11 @@ def analyze(
     when ``stage_programs`` (per-stage op sequences, see
     :mod:`.hb_pass`) is given; the donation pass runs when ``plan`` (a
     DispatchPlan/CompiledSchedule or their metadata dict, see
-    :mod:`.donation_pass`) is given.
+    :mod:`.donation_pass`) is given; the page-lifetime prover runs when
+    ``page_events`` (a ``PageOwnershipLog``/snapshot, see
+    :mod:`.page_pass`) is given; the request-lifecycle checker runs when
+    ``request_log`` (a ``RequestLog``/snapshot/row list, with
+    ``request_log_final=True`` for completed runs) is given.
 
     The returned report is stamped with ``schedule.signature()`` when a
     schedule was analyzed, so it can be handed straight back to
@@ -181,6 +196,12 @@ def analyze(
         rep.extend(analyze_happens_before(stage_programs))
     if plan is not None:
         rep.extend(analyze_donation(plan))
+    if page_events is not None:
+        rep.extend(analyze_pages(page_events))
+    if request_log is not None:
+        rep.extend(
+            analyze_lifecycle(request_log, final=request_log_final)
+        )
     if schedule is not None:
         rep.schedule_signature = schedule.signature()
     return rep

@@ -227,6 +227,7 @@ def _subjaxprs(eqn: Any):
         if j is None or not hasattr(j, "eqns"):
             j = v if hasattr(v, "eqns") else None
         if j is not None and id(j) not in seen:
+            # dls-lint: allow(DET004) in-process jaxpr dedup, never serialized
             seen.add(id(j))
             yield j
 

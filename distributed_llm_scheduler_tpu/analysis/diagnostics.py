@@ -106,6 +106,24 @@ CODES: Dict[str, str] = {
     "STR002": "streamed schedule compilable only with a pinned prefix",
     "STR003": "streamed schedule is interpreter-only (must evict from "
               "the first task)",
+    # -- page-lifetime prover (page_pass) -------------------------------
+    "PGL001": "orphaned page: allocated but never freed",
+    "PGL002": "double-free in the page ownership event stream",
+    "PGL003": "page freed while still referenced by a live page table",
+    "PGL004": "reserved trash page crossed the allocator",
+    "PGL005": "pool accounting mismatch: free + used do not tile the pool",
+    # -- request-lifecycle protocol (lifecycle_pass) --------------------
+    "LCY001": "illegal lifecycle transition (state/timestamp mismatch)",
+    "LCY002": "non-monotone per-request timestamps (time travel)",
+    "LCY003": "non-terminal state in a finished request log",
+    "LCY004": "unknown lifecycle state",
+    "LCY005": "token accounting disagrees with the delivery series",
+    # -- determinism lint (determinism_pass) ----------------------------
+    "DET001": "wall-clock read outside obs/clockutil.py",
+    "DET002": "global/unseeded RNG in serve/, sched/, or obs/",
+    "DET003": "iteration over an unordered set feeds downstream state",
+    "DET004": "id()-keyed container (process-dependent keys)",
+    "DET005": "environment read outside utils/config.py",
 }
 
 

@@ -103,7 +103,7 @@ def fix_per_node_order(
             deps = graph[t].dependencies
         except KeyError:
             continue
-        for d in set(deps):
+        for d in sorted(set(deps)):
             if d in placed and d != t:
                 indeg[t] += 1
                 dependents[d].append(t)

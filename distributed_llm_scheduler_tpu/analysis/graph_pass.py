@@ -23,7 +23,7 @@ def _cycle_members(graph: TaskGraph) -> tuple:
     indeg: Dict[str, int] = {tid: 0 for tid in known}
     out: Dict[str, List[str]] = {tid: [] for tid in known}
     for t in graph.tasks():
-        for d in set(t.dependencies):
+        for d in sorted(set(t.dependencies)):
             if d in known:
                 indeg[t.task_id] += 1
                 out[d].append(t.task_id)

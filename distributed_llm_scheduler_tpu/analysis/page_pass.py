@@ -1,0 +1,326 @@
+"""Pass — page-lifetime prover (PGL001-PGL005).
+
+Replays the append-only ownership event stream recorded by the
+:class:`~..models.kv_pages.PageOwnershipLog` seam against an ownership
+lattice.  Two event families interleave in the stream:
+
+* pool-level ``alloc`` / ``free`` — emitted by :class:`~..models.
+  kv_pages.PagePool` itself, carrying the post-event free/used counts
+  (the tiling witness: ``free + used`` must equal ``n_pages - 1``,
+  page 0 being the reserved trash page);
+* engine-level ``assign`` / ``release`` — emitted by
+  :class:`~..backends.decode_loop.PagedDecodeEngine` at its lifecycle
+  edges (admit / retire / preempt / reset), attributing each page to the
+  owning request id.
+
+The lattice each page moves through is ``unallocated → allocated →
+owned → released → unallocated``; any edge skipped or repeated is a
+diagnostic:
+
+======  ==========================================================
+PGL001  orphaned page: allocated but never freed (end-of-log), with
+        the exact alloc event and last owner rid + site
+PGL002  double-free: ``free`` of a page not currently allocated
+PGL003  use-after-free hazard: ``free`` of a page whose owner never
+        released it (the page table still references it)
+PGL004  the reserved trash page crossed the allocator
+PGL005  accounting mismatch: the free list + allocated set stop
+        tiling the pool, or the ownership protocol itself is violated
+        (assign of an unallocated page, second live owner, release by
+        a non-owner)
+======  ==========================================================
+
+This is exactly how the ``_LeakyPool`` soak injector is caught
+statically: the wrapper withholds pages *between* the engine's
+``release`` and the inner pool's ``free``, so the withheld page shows an
+``alloc``/``assign`` pair with no matching ``free`` — PGL001 with the
+owning rid and alloc site, no hour of soak required.
+
+:func:`analyze_serve_artifact` applies the same gate offline to a
+committed ``dls.serve/1`` / ``dls.soak/1`` artifact (the ``doctor
+--serve`` path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from ..models.kv_pages import TRASH_PAGE
+from .diagnostics import AnalysisReport, Severity
+
+
+def _events_of(source: Any) -> List[Dict[str, Any]]:
+    """Normalize a PageOwnershipLog, its ``snapshot()`` dict, or a bare
+    event list into the event list."""
+    if source is None:
+        return []
+    events = getattr(source, "events", None)
+    if events is not None:
+        return list(events)
+    if isinstance(source, dict):
+        return list(source.get("events", []))
+    return list(source)
+
+
+def _n_pages_of(source: Any, n_pages: Optional[int]) -> Optional[int]:
+    if n_pages is not None:
+        return int(n_pages)
+    got = getattr(source, "n_pages", None)
+    if got is None and isinstance(source, dict):
+        got = source.get("n_pages")
+    return int(got) if got is not None else None
+
+
+def analyze_pages(
+    source: Any,
+    *,
+    n_pages: Optional[int] = None,
+    final: bool = True,
+) -> AnalysisReport:
+    """Replay an ownership event stream; one diagnostic per violation.
+
+    ``source``: a ``PageOwnershipLog``, its ``snapshot()`` dict
+    (``dls.pages/1``), or a raw event list.  ``n_pages`` (pool size
+    incl. the trash page) enables the tiling check; it is read off the
+    source when not given.  ``final=False`` suppresses the end-of-log
+    orphan scan (PGL001) for streams snapshotted mid-run.
+    """
+    rep = AnalysisReport()
+    events = _events_of(source)
+    pool_pages = _n_pages_of(source, n_pages)
+
+    # page -> seq of the alloc event currently covering it
+    allocated: Dict[int, int] = {}
+    # page -> (owner rid, site, assign seq) while an owner is live
+    owner_of: Dict[int, tuple] = {}
+    # page -> (owner rid, site, assign seq) surviving release, for
+    # orphan attribution at end-of-log
+    last_owner: Dict[int, tuple] = {}
+
+    for ev in events:
+        seq = ev.get("seq")
+        kind = ev.get("kind")
+        pages = ev.get("pages", ())
+        owner = ev.get("owner")
+        site = ev.get("site")
+
+        if TRASH_PAGE in pages:
+            rep.add(
+                "PGL004",
+                Severity.ERROR,
+                f"event {seq} ({kind}) touches the reserved trash page "
+                f"{TRASH_PAGE}",
+                data={"event": seq, "kind": kind},
+            )
+
+        if kind == "alloc":
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                if p in allocated:
+                    rep.add(
+                        "PGL005",
+                        Severity.ERROR,
+                        f"event {seq}: page {p} allocated twice without "
+                        f"an intervening free (first at event "
+                        f"{allocated[p]})",
+                        data={"page": p, "event": seq},
+                    )
+                allocated[p] = seq
+        elif kind == "assign":
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                if p not in allocated:
+                    rep.add(
+                        "PGL005",
+                        Severity.ERROR,
+                        f"event {seq}: page {p} assigned to "
+                        f"{owner!r} without a covering alloc",
+                        task=owner,
+                        data={"page": p, "owner": owner, "event": seq},
+                    )
+                if p in owner_of and owner_of[p][0] != owner:
+                    rep.add(
+                        "PGL005",
+                        Severity.ERROR,
+                        f"event {seq}: page {p} assigned to {owner!r} "
+                        f"while still owned by {owner_of[p][0]!r} "
+                        f"(assigned at event {owner_of[p][2]})",
+                        task=owner,
+                        data={"page": p, "owner": owner,
+                              "prev_owner": owner_of[p][0]},
+                    )
+                owner_of[p] = (owner, site, seq)
+                last_owner[p] = (owner, site, seq)
+        elif kind == "release":
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                live = owner_of.get(p)
+                if live is None:
+                    rep.add(
+                        "PGL005",
+                        Severity.ERROR,
+                        f"event {seq}: {owner!r} releases page {p} "
+                        f"({site}) which has no live owner",
+                        task=owner,
+                        data={"page": p, "owner": owner, "event": seq},
+                    )
+                elif live[0] != owner:
+                    rep.add(
+                        "PGL005",
+                        Severity.ERROR,
+                        f"event {seq}: {owner!r} releases page {p} "
+                        f"({site}) owned by {live[0]!r}",
+                        task=owner,
+                        data={"page": p, "owner": owner,
+                              "live_owner": live[0]},
+                    )
+                owner_of.pop(p, None)
+        elif kind == "free":
+            for p in pages:
+                if p == TRASH_PAGE:
+                    continue
+                if p not in allocated:
+                    rep.add(
+                        "PGL002",
+                        Severity.ERROR,
+                        f"event {seq}: double-free of page {p} "
+                        "(not currently allocated)",
+                        data={"page": p, "event": seq},
+                    )
+                    continue
+                live = owner_of.get(p)
+                if live is not None:
+                    rep.add(
+                        "PGL003",
+                        Severity.ERROR,
+                        f"event {seq}: page {p} freed while still "
+                        f"referenced by live owner {live[0]!r}'s page "
+                        f"table (assigned at event {live[2]})",
+                        task=live[0],
+                        data={"page": p, "owner": live[0],
+                              "event": seq},
+                    )
+                    owner_of.pop(p, None)
+                allocated.pop(p, None)
+        else:
+            rep.add(
+                "PGL005",
+                Severity.ERROR,
+                f"event {seq}: unknown event kind {kind!r}",
+                data={"event": seq, "kind": kind},
+            )
+
+        # tiling witness: pool-level events carry post-event counts
+        if kind in ("alloc", "free") and pool_pages is not None:
+            free_ct = ev.get("free_pages")
+            used_ct = ev.get("used_pages")
+            if free_ct is not None and used_ct is not None:
+                if free_ct + used_ct != pool_pages - 1:
+                    rep.add(
+                        "PGL005",
+                        Severity.ERROR,
+                        f"event {seq}: free ({free_ct}) + used "
+                        f"({used_ct}) pages do not tile the pool "
+                        f"({pool_pages - 1} usable)",
+                        data={"event": seq, "free": free_ct,
+                              "used": used_ct},
+                    )
+                if used_ct != len(allocated):
+                    rep.add(
+                        "PGL005",
+                        Severity.ERROR,
+                        f"event {seq}: pool reports {used_ct} pages "
+                        f"used but the event stream accounts for "
+                        f"{len(allocated)}",
+                        data={"event": seq, "used": used_ct,
+                              "replayed": len(allocated)},
+                    )
+
+    if final:
+        for p in sorted(allocated):
+            who = last_owner.get(p)
+            if who is not None:
+                owner, site, aseq = who
+                rep.add(
+                    "PGL001",
+                    Severity.ERROR,
+                    f"orphaned page {p}: allocated at event "
+                    f"{allocated[p]} for request {owner!r} "
+                    f"(site={site}, assign event {aseq}) and never "
+                    "freed",
+                    task=owner,
+                    data={"page": p, "owner": owner, "site": site,
+                          "alloc_event": allocated[p]},
+                )
+            else:
+                rep.add(
+                    "PGL001",
+                    Severity.ERROR,
+                    f"orphaned page {p}: allocated at event "
+                    f"{allocated[p]} and never freed (no recorded "
+                    "owner)",
+                    data={"page": p, "alloc_event": allocated[p]},
+                )
+    return rep
+
+
+def analyze_serve_artifact(art: Dict[str, Any]) -> AnalysisReport:
+    """Offline gate over a committed ``dls.serve/1`` or ``dls.soak/1``
+    artifact: re-checks the page-leak counters, replays any embedded
+    ownership event stream, and lints any embedded request rows through
+    the lifecycle pass.  Raises :class:`ValueError` on an unknown
+    schema (the ``doctor --serve`` exit-2 path).
+    """
+    from .lifecycle_pass import analyze_lifecycle
+
+    rep = AnalysisReport()
+    schema = art.get("schema")
+    if schema == "dls.serve/1":
+        legs = art.get("legs", {})
+        for leg, body in legs.items():
+            leaked = body.get("pages_leaked", 0)
+            if leaked:
+                rep.add(
+                    "PGL001",
+                    Severity.ERROR,
+                    f"leg {leg!r}: artifact reports {leaked} leaked "
+                    "page(s); events are not embedded — run "
+                    "`lint --serving` for per-page attribution",
+                    task=leg,
+                    data={"leg": leg, "pages_leaked": leaked},
+                )
+            if "page_events" in body:
+                rep.extend(analyze_pages(body["page_events"]))
+            if "requests" in body:
+                rep.extend(
+                    analyze_lifecycle(
+                        body["requests"], final=True, label=leg
+                    )
+                )
+    elif schema == "dls.soak/1":
+        serving = art.get("serving", {})
+        leaked = serving.get("pages_leaked", 0)
+        if leaked:
+            rep.add(
+                "PGL001",
+                Severity.ERROR,
+                f"soak artifact reports {leaked} leaked page(s)",
+                data={"pages_leaked": leaked},
+            )
+        if "page_events" in serving:
+            rep.extend(analyze_pages(serving["page_events"]))
+        if "requests" in serving:
+            rep.extend(
+                analyze_lifecycle(
+                    serving["requests"], final=True, label="soak"
+                )
+            )
+    else:
+        raise ValueError(
+            f"not a serve/soak artifact (schema={schema!r}; expected "
+            "dls.serve/1 or dls.soak/1)"
+        )
+    return rep

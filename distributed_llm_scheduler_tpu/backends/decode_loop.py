@@ -439,6 +439,11 @@ class PagedDecodeEngine:
         # page_size rows x (Hkv, hd) x k+v x n_layers.  Explicit only;
         # None costs nothing (every record below is None-guarded).
         self.memprof = memprof
+        # page-ownership event seam (analysis/page_pass): None by default
+        # — every record site below is None-guarded, so the bare engine
+        # is bit-identical to an instrumented one.  Wire it with
+        # attach_ownership_log() or rebind_obs(ownlog=...).
+        self.ownlog = None
         self._page_bytes = (
             n_layers * 2 * pool.page_size * n_kv * hd
             * np.dtype(config.dtype).itemsize
@@ -452,6 +457,27 @@ class PagedDecodeEngine:
         if self.flight is not None:
             return (self.reqlog, self.flight.reqlog)
         return (self.reqlog,)
+
+    def attach_ownership_log(self, log: Any) -> None:
+        """Wire (or, with ``None``, unwire) the append-only
+        page-ownership event seam (:class:`...models.kv_pages.
+        PageOwnershipLog`).
+
+        The engine records the owner-attributed ``assign``/``release``
+        events at its lifecycle edges; the pool itself records the
+        low-level ``alloc``/``free`` events with the tiling counts —
+        fault injectors wrap the pool in a delegating proxy, so the
+        recorder is planted on the INNER pool (the proxy's withheld
+        pages then surface as allocs that never see a free, which is
+        exactly what the prover flags)."""
+        self.ownlog = log
+        pool = self.pool
+        inner = getattr(pool, "_inner", None)
+        if inner is not None:
+            pool = inner
+        pool.ownlog = log
+        if log is not None and getattr(log, "n_pages", None) is None:
+            log.n_pages = pool.n_pages
 
     def reset(self) -> None:
         """Fresh pool/table/queue state, compiled programs kept.
@@ -470,6 +496,11 @@ class PagedDecodeEngine:
         np = self._np
         for s, pages in enumerate(self._slot_pages):
             if pages:
+                if self.ownlog is not None:
+                    self.ownlog.record(
+                        "release", pages,
+                        owner=str(self._slot_req[s]), site="reset",
+                    )
                 self.pool.free(pages)
                 if self.memprof is not None:
                     self.memprof.free(
@@ -511,6 +542,7 @@ class PagedDecodeEngine:
         metrics: Any = None,
         flight: Any = None,
         memprof: Any = None,
+        ownlog: Any = None,
     ) -> None:
         """Re-wire the observability surfaces and wipe run state, keeping
         the compiled executables.
@@ -557,6 +589,7 @@ class PagedDecodeEngine:
         self.pool = PagePool(
             n_pages=self.pool.n_pages, page_size=self.pool.page_size
         )
+        self.attach_ownership_log(ownlog)
         self.__dict__.pop("step_segment", None)
         # reset() rebuilds pools/tables/reqlog against the just-bound
         # clock and flight sinks
@@ -774,6 +807,10 @@ class PagedDecodeEngine:
                         self._mem_node, f"kv:{rid}",
                         need * self._page_bytes, "kv_pages",
                     )
+                if self.ownlog is not None:
+                    self.ownlog.record(
+                        "assign", pages, owner=str(rid), site="admit"
+                    )
             # unconditional read: t_pf0 is each batched request's
             # admission timestamp in the lifecycle log
             t_pf0 = self._clock()
@@ -821,6 +858,11 @@ class PagedDecodeEngine:
 
     def _retire(self, s: int) -> None:
         rid = self._slot_req[s]
+        if self.ownlog is not None:
+            self.ownlog.record(
+                "release", self._slot_pages[s], owner=str(rid),
+                site="retire",
+            )
         self.pool.free(self._slot_pages[s])
         if self.memprof is not None:
             self.memprof.free(self._mem_node, f"kv:{rid}")
@@ -882,6 +924,11 @@ class PagedDecodeEngine:
             self._tokens.pop(rid), dtype=self._np.int32
         )
         remaining = int(self.remaining[slot])
+        if self.ownlog is not None:
+            self.ownlog.record(
+                "release", self._slot_pages[slot], owner=str(rid),
+                site="preempt",
+            )
         self.pool.free(self._slot_pages[slot])
         if self.memprof is not None:
             self.memprof.free(self._mem_node, f"kv:{rid}")

@@ -34,6 +34,7 @@ in-process "multi-node" strategy (SURVEY.md §4).
 """
 
 from __future__ import annotations
+# dls-lint: allow-file(DET001) real-device execution timing: wall time IS the measured quantity
 
 import time
 import warnings

@@ -19,6 +19,7 @@ root (next to the earlier rounds' artifacts the judge diffs against).
 """
 
 from __future__ import annotations
+# dls-lint: allow-file(DET001) capture harness: leg timeouts need the host clock
 
 import json
 import os
@@ -26,6 +27,8 @@ import sys
 import time
 import traceback
 from typing import Any, Callable, Dict
+
+from ..utils.config import env_str
 
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -69,7 +72,7 @@ def _guarded(name: str, fn: Callable[[], Dict[str, Any]]) -> Dict[str, Any]:
     import signal
     import threading
 
-    budget = float(os.environ.get("DLS_CAPTURE_LEG_TIMEOUT", "1200"))
+    budget = float(env_str("DLS_CAPTURE_LEG_TIMEOUT", "1200"))
     t0 = time.time()
 
     def _alarm(signum, frame):

@@ -14,6 +14,7 @@ program, so the measurement is one fence-amortized timing of that program
 """
 
 from __future__ import annotations
+# dls-lint: allow-file(DET001) benchmark harness: wall time IS the measured quantity
 
 import functools
 import sys

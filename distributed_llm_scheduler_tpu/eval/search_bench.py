@@ -28,12 +28,15 @@ JAX initializes, so no accelerator is needed (and none is used).
 """
 
 from __future__ import annotations
+# dls-lint: allow-file(DET001) benchmark harness: wall time IS the measured quantity
 
 import os
 
+from ..utils.config import env_str
+
 # must be set before jax initializes its backend (conftest.py does the
 # same for tests)
-_flags = os.environ.get("XLA_FLAGS", "")
+_flags = env_str("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"

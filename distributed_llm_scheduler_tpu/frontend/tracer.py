@@ -16,6 +16,8 @@ fallback extractor for arbitrary models.  Structured frontends (e.g.
 
 from __future__ import annotations
 
+# dls-lint: allow-file(DET004) jaxpr vars are unhashable-by-value; the
+#   id()-keyed const-origin memo lives and dies inside one trace call
 from typing import Any, Callable, Dict, Optional
 
 import jax

@@ -49,6 +49,63 @@ DEFAULT_PAGE_SIZE = 16
 TRASH_PAGE = 0
 
 
+#: Schema tag for :meth:`PageOwnershipLog.snapshot`.
+OWNERSHIP_SCHEMA = "dls.pages/1"
+
+
+class PageOwnershipLog:
+    """Append-only page ownership event stream — the static third leg of
+    the page-accounting story next to the runtime ``pages_leaked`` gauge.
+
+    Producers record four event kinds: ``alloc``/``free`` (the
+    :class:`PagePool` itself, with the pool's free/used counts after the
+    event — the tiling witness) and ``assign``/``release`` (the decode
+    engine, with the owning request id and the lifecycle edge —
+    ``admit``/``retire``/``preempt``/``reset``).  The page-lifetime
+    prover (:mod:`..analysis.page_pass`) replays the stream against an
+    ownership lattice; recording is a dict append per pool operation and
+    is completely off (zero overhead, bit-identical engine behavior)
+    when no log is attached — the same None-guard contract as the
+    memory profiler seam.
+    """
+
+    def __init__(self, n_pages: Optional[int] = None):
+        self.n_pages = n_pages
+        self.events: List[Dict[str, Any]] = []
+
+    def record(
+        self,
+        kind: str,
+        pages: Sequence[int],
+        *,
+        owner: Optional[str] = None,
+        site: Optional[str] = None,
+        free_pages: Optional[int] = None,
+        used_pages: Optional[int] = None,
+    ) -> None:
+        self.events.append({
+            "seq": len(self.events),
+            "kind": kind,
+            "pages": [int(p) for p in pages],
+            "owner": owner,
+            "site": site,
+            "free_pages": free_pages,
+            "used_pages": used_pages,
+        })
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready view (schema ``dls.pages/1``) — what a serve/soak
+        artifact embeds so ``doctor --serve`` can replay it offline."""
+        return {
+            "schema": OWNERSHIP_SCHEMA,
+            "n_pages": self.n_pages,
+            "events": [dict(e) for e in self.events],
+        }
+
+
 def pages_needed(n_tokens: int, page_size: int) -> int:
     """Pages covering ``n_tokens`` rows (ceil division)."""
     if n_tokens < 0:
@@ -79,6 +136,10 @@ class PagePool:
     page_size: int = DEFAULT_PAGE_SIZE
     _free: List[int] = field(default_factory=list, repr=False)
     _allocated: set = field(default_factory=set, repr=False)
+    #: optional :class:`PageOwnershipLog`; every alloc/free appends one
+    #: event carrying the post-event free/used counts (the tiling
+    #: witness).  None — the default — records nothing and costs nothing.
+    ownlog: Optional[Any] = field(default=None, repr=False, compare=False)
 
     def __post_init__(self) -> None:
         if self.n_pages < 2:
@@ -145,6 +206,11 @@ class PagePool:
             )
         pages = [self._free.pop() for _ in range(n)]
         self._allocated.update(pages)
+        if self.ownlog is not None:
+            self.ownlog.record(
+                "alloc", pages,
+                free_pages=len(self._free), used_pages=len(self._allocated),
+            )
         return pages
 
     def alloc_for_tokens(self, n_tokens: int) -> List[int]:
@@ -154,6 +220,7 @@ class PagePool:
         """Return pages to the free list; double-free and trash-page
         frees are hard errors (a silent one would hand the same page to
         two sequences)."""
+        pages = list(pages)
         for p in pages:
             if p == TRASH_PAGE:
                 raise ValueError("page 0 is reserved and never allocated")
@@ -161,6 +228,11 @@ class PagePool:
                 raise ValueError(f"double free of page {p}")
             self._allocated.discard(p)
             self._free.append(p)
+        if self.ownlog is not None:
+            self.ownlog.record(
+                "free", pages,
+                free_pages=len(self._free), used_pages=len(self._allocated),
+            )
 
 
 def init_paged_kv(
@@ -318,7 +390,9 @@ def paged_param_bytes(
 
 __all__ = [
     "DEFAULT_PAGE_SIZE",
+    "OWNERSHIP_SCHEMA",
     "TRASH_PAGE",
+    "PageOwnershipLog",
     "PagePool",
     "pages_needed",
     "pool_bytes_per_layer",

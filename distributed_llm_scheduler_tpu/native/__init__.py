@@ -18,6 +18,8 @@ import threading
 from pathlib import Path
 from typing import Optional
 
+from ..utils.config import env_str
+
 _HERE = Path(__file__).resolve().parent
 _SOURCE = _HERE / "engine.cpp"
 _BUILD_DIR = _HERE / "_build"
@@ -52,7 +54,7 @@ def _compile(so: Path) -> None:
     _BUILD_DIR.mkdir(exist_ok=True)
     tmp = so.with_suffix(f".tmp{os.getpid()}.so")
     cmd = [
-        os.environ.get("CXX", "g++"),
+        env_str("CXX", "g++"),
         "-O3", "-std=c++17", "-fPIC", "-shared",
         str(_SOURCE), "-o", str(tmp),
     ]

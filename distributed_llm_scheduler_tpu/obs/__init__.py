@@ -50,9 +50,9 @@ getters return ``None`` and instrumented hot paths skip all recording
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
+from ..utils.config import env_flag
 from .attribution import Attribution, attribute_run, attribute_trace
 from .clockutil import Clock, default_clock, resolve_clock
 from .drift import DriftReport, compute_drift
@@ -87,8 +87,6 @@ from .timeseries import (
 )
 from .trace import HOST_TRACK, Tracer
 
-_TRUTHY = ("1", "true", "yes", "on")
-
 _ambient_tracer: Optional[Tracer] = None
 _ambient_metrics: Optional[MetricsRegistry] = None
 _ambient_flight: Optional[FlightRecorder] = None
@@ -96,7 +94,7 @@ _ambient_flight: Optional[FlightRecorder] = None
 
 def trace_enabled() -> bool:
     """True when ``DLS_TRACE`` requests ambient observability."""
-    return os.environ.get("DLS_TRACE", "").strip().lower() in _TRUTHY
+    return env_flag("DLS_TRACE")
 
 
 def ambient_tracer() -> Optional[Tracer]:
@@ -123,7 +121,7 @@ def ambient_metrics() -> Optional[MetricsRegistry]:
 
 def flight_enabled() -> bool:
     """True when ``DLS_FLIGHT`` requests the ambient flight recorder."""
-    return os.environ.get("DLS_FLIGHT", "").strip().lower() in _TRUTHY
+    return env_flag("DLS_FLIGHT")
 
 
 def ambient_flight() -> Optional[FlightRecorder]:

@@ -192,6 +192,7 @@ def _attribute(
     cur = terminal
     seen = set()
     while cur is not None and id(cur) not in seen:
+        # dls-lint: allow(DET004) in-process cycle guard, never serialized
         seen.add(id(cur))
         best_flow = None
         for f in flows:

@@ -250,6 +250,59 @@ class RequestLog:
 
 
 # -- schema ---------------------------------------------------------------
+
+def timestamp_order_errors(row: Dict[str, Any]) -> List[str]:
+    """Strict time-travel check over one request row's timestamps.
+
+    Returns one message per violation of the lifecycle ordering
+    ``t_submit <= t_admit <= t_first_token <= t_retire/t_preempt`` and
+    the non-decreasing delivery series anchored at the first token.
+    Only strict DEcreases are violations: the virtual clock legitimately
+    stamps consecutive lifecycle events with equal times (e.g. the last
+    delivery and the retire share one segment fold).  Shared by
+    :func:`validate_request_log` and the lifecycle analysis pass so the
+    two can never disagree on what counts as time travel.
+    """
+    errs: List[str] = []
+
+    def _chain(a_name: str, b_name: str) -> None:
+        a, b = row.get(a_name), row.get(b_name)
+        if a is not None and b is not None and float(b) < float(a):
+            errs.append(
+                f"{b_name} ({b}) precedes {a_name} ({a})"
+            )
+
+    _chain("t_submit", "t_admit")
+    _chain("t_admit", "t_first_token")
+    _chain("t_first_token", "t_retire")
+    _chain("t_first_token", "t_preempt")
+    dl = row.get("deliveries")
+    if isinstance(dl, list) and all(
+        isinstance(d, (list, tuple)) and len(d) == 2 for d in dl
+    ):
+        t_ft = row.get("t_first_token")
+        prev = None
+        for j, (t, _n) in enumerate(dl):
+            if t_ft is not None and float(t) < float(t_ft):
+                errs.append(
+                    f"deliveries[{j}] at {t} precedes t_first_token "
+                    f"({t_ft})"
+                )
+            if prev is not None and float(t) < float(prev):
+                errs.append(
+                    f"deliveries[{j}] at {t} precedes deliveries"
+                    f"[{j - 1}] at {prev}"
+                )
+            prev = t
+        t_ret = row.get("t_retire")
+        if dl and t_ret is not None and float(t_ret) < float(dl[-1][0]):
+            errs.append(
+                f"t_retire ({t_ret}) precedes the last delivery "
+                f"({dl[-1][0]})"
+            )
+    return errs
+
+
 _REQUIRED = (
     "rid", "prompt_len", "max_new_tokens", "state", "t_submit", "t_admit",
     "t_first_token", "t_retire", "n_tokens", "deliveries", "queue_wait_s",
@@ -277,8 +330,10 @@ def validate_request_log(snap: Any) -> List[str]:
             if f not in row:
                 errs.append(f"requests[{i}] missing {f!r}")
         state = row.get("state")
-        if state is not None and state not in STATES:
+        if state not in STATES:
             errs.append(f"requests[{i}] unknown state {state!r}")
+        for msg in timestamp_order_errors(row):
+            errs.append(f"requests[{i}] {msg}")
         if row.get("state") == "retired":
             for f in ("t_admit", "t_first_token", "t_retire"):
                 if row.get(f) is None:
@@ -346,5 +401,6 @@ __all__ = [
     "SCHEMA",
     "STATES",
     "summarize_request_log",
+    "timestamp_order_errors",
     "validate_request_log",
 ]

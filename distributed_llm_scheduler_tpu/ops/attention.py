@@ -37,12 +37,13 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ..utils.config import env_str
 
 try:  # pallas TPU backend is absent on some CPU-only builds
     from jax.experimental.pallas import tpu as pltpu
@@ -184,7 +185,7 @@ def reference_mha(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
 
 
 def _auto_impl() -> str:
-    forced = os.environ.get("DLS_TPU_ATTENTION_IMPL")
+    forced = env_str("DLS_TPU_ATTENTION_IMPL")
     if forced:
         return forced
     try:

@@ -20,12 +20,13 @@ are their executable TPU forms.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from ..utils.config import env_str
 
 
 def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
@@ -89,7 +90,7 @@ def _rms_pallas(x2d, g, *, eps, interpret):
 
 
 def _auto_impl() -> str:
-    forced = os.environ.get("DLS_TPU_NORM_IMPL")
+    forced = env_str("DLS_TPU_NORM_IMPL")
     if forced:
         return forced
     try:

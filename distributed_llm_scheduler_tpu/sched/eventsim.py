@@ -125,7 +125,7 @@ def simulate_placement_timeline(
     node_free: Dict[str, float] = {}
     load_queue_end: Dict[str, float] = {}
     cached: Dict[str, set] = {}
-    for nid in set(placement.values()):
+    for nid in sorted(set(placement.values())):
         ready[nid] = []
         node_free[nid] = 0.0
         load_queue_end[nid] = 0.0

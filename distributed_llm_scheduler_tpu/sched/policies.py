@@ -317,9 +317,8 @@ def get_scheduler(name: str, link=None, **kwargs) -> BaseScheduler:
     call site can configure the whole registry uniformly.
     """
     import inspect
-    import os
-
     from ..backends.sim import TieredLinkModel
+    from ..utils.config import env_str
 
     tiered = isinstance(link, TieredLinkModel)
     if name.startswith("native:"):
@@ -330,7 +329,7 @@ def get_scheduler(name: str, link=None, **kwargs) -> BaseScheduler:
         raise ValueError(
             f"unknown scheduler {name!r}; available: {sorted(ALL_SCHEDULERS)}"
         )
-    if os.environ.get("DLS_NATIVE") == "1" and not tiered:
+    if env_str("DLS_NATIVE") == "1" and not tiered:
         from .. import native as native_mod
         from .native import NativeScheduler
 

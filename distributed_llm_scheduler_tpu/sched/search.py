@@ -318,7 +318,10 @@ class SearchScheduler(GroupPackScheduler):
         flt = _TaskMoveFilter(run, devices, cur)
         tids = sorted(cur)
         crit, hops = self._critical_tasks(graph, devices, cur, tl, slices)
+        # time_budget_s users opt into a nondeterministic cutoff; the
+        # deterministic knob (and the default) is the eval budget
         deadline = (
+            # dls-lint: allow(DET001) opt-in wall-time budget
             time.perf_counter() + self.time_budget_s
             if self.time_budget_s is not None else None
         )
@@ -440,6 +443,7 @@ class SearchScheduler(GroupPackScheduler):
                 slice_i = 0
 
         while evals < self.budget and attempts < max_attempts:
+            # dls-lint: allow(DET001) opt-in time_budget_s cutoff (see above)
             if deadline is not None and time.perf_counter() >= deadline:
                 break
             attempts += 1

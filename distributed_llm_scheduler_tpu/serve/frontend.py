@@ -490,6 +490,18 @@ class ServingFrontend:
         ``shed``/``preempted``; ``t_submit`` is the ARRIVAL time."""
         return self._rows()
 
+    def lint(self, *, final: bool = True):
+        """Run the request-lifecycle protocol checker (LCY00x) over this
+        frontend's live request rows; returns the
+        :class:`~..analysis.diagnostics.AnalysisReport`.  ``final=True``
+        (the default) additionally requires every request to have
+        reached a terminal state — pass ``False`` mid-run."""
+        from ..analysis.lifecycle_pass import analyze_lifecycle
+
+        return analyze_lifecycle(
+            self._rows(), final=final, label="serving"
+        )
+
     # -- reporting ---------------------------------------------------------
     def report(self) -> Dict[str, Any]:
         """Serving-leg summary: goodput (tokens/s of SLO-meeting

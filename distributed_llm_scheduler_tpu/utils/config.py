@@ -10,7 +10,30 @@ distributed_llm_scheduler_tpu <cmd>`` just works.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
+
+# -- environment seam ------------------------------------------------------
+# The ONE module allowed to consult os.environ (determinism lint DET005):
+# every env-tunable in the tree reads through these helpers, so the full
+# set of environment inputs is greppable from one place and the
+# reproducibility battery knows exactly which ambient state can matter.
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw environment read (the DET005 seam)."""
+    return os.environ.get(name, default)
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean environment read: unset -> ``default``; set -> truthy iff
+    the value is one of ``1/true/yes/on`` (case-insensitive)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in _TRUTHY
 
 
 @dataclasses.dataclass

@@ -9,6 +9,7 @@ persist to JSON keyed by graph name + platform so reruns skip measurement.
 """
 
 from __future__ import annotations
+# dls-lint: allow-file(DET001) calibration measures real step/transfer time
 
 import json
 import os
@@ -16,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 from ..core.graph import TaskGraph
+from .config import env_str
 
 
 @dataclass
@@ -421,7 +423,7 @@ def recalibrate_requested() -> bool:
     as ``refresh=`` so committed calibration caches can't masquerade as
     live measurements across rounds.  Library callers (and tests) are NOT
     env-sensitive — they get cache semantics unless they opt in."""
-    return os.environ.get("DLS_RECALIBRATE", "").strip().lower() not in (
+    return (env_str("DLS_RECALIBRATE") or "").strip().lower() not in (
         "", "0", "false", "no", "off"
     )
 

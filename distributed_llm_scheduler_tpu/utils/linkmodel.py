@@ -26,6 +26,7 @@ CPU mesh measures both legs for real.
 """
 
 from __future__ import annotations
+# dls-lint: allow-file(DET001) link calibration measures real transfer time
 
 import json
 import os

@@ -1,0 +1,7 @@
+"""DET001 fixture: raw wall-clock reads outside obs/clockutil.py."""
+import time
+from datetime import datetime
+
+t0 = time.time()
+t1 = time.perf_counter()
+stamp = datetime.now()

@@ -1,0 +1,10 @@
+"""DET003 fixture: iteration directly over sets."""
+deps = {"b", "a", "c"}
+
+for d in deps | {"d"}:  # noqa: F841 -- not flagged: not a literal/ctor
+    pass
+
+for d in {"b", "a", "c"}:
+    pass
+
+order = [x for x in set(deps)]

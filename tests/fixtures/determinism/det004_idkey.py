@@ -1,0 +1,8 @@
+"""DET004 fixture: id()-keyed containers."""
+memo = {}
+obj = object()
+
+memo[id(obj)] = 1
+seen = set()
+seen.add(id(obj))
+table = {id(obj): "x"}

@@ -1,0 +1,6 @@
+"""DET005 fixture: environment reads outside utils/config.py."""
+import os
+
+a = os.environ.get("DLS_FIXTURE")
+b = os.getenv("DLS_FIXTURE")
+c = os.environ["DLS_FIXTURE"]

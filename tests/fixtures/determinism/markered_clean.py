@@ -1,0 +1,13 @@
+"""Marker fixture: every violation here carries a justification the
+lint must honor (line marker covers its line + the next; file marker
+covers one code for the whole file)."""
+# dls-lint: allow-file(DET005) fixture exercises the file-level marker
+import os
+import time
+
+# dls-lint: allow(DET001) fixture exercises the line-above marker
+t0 = time.time()
+t1 = time.perf_counter()  # dls-lint: allow(DET001) same-line marker
+
+a = os.environ.get("DLS_FIXTURE")
+b = os.environ["DLS_FIXTURE"]

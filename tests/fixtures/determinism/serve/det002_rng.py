@@ -1,0 +1,7 @@
+"""DET002 fixture: global RNG inside a serve/ tree."""
+import random
+
+import numpy as np
+
+jitter = random.random()
+noise = np.random.normal(0.0, 1.0)

@@ -933,3 +933,163 @@ def test_lint_cli_parallel():
 
     assert main(["lint", "--parallel"]) == 0
     assert main(["lint", "--parallel", "--decode"]) == 2
+
+
+# -- serving safety: lifecycle (LCY) + determinism (DET) ---------------------
+
+from pathlib import Path  # noqa: E402
+
+from distributed_llm_scheduler_tpu.analysis import (  # noqa: E402
+    analyze_determinism,
+    analyze_lifecycle,
+)
+from distributed_llm_scheduler_tpu.obs.reqlog import (  # noqa: E402
+    RequestLog,
+    validate_request_log,
+)
+
+_FIXTURES = Path(__file__).parent / "fixtures" / "determinism"
+
+
+def _row(**kw):
+    """A legal retired engine row; override fields to break it."""
+    row = {
+        "rid": "r0", "prompt_len": 8, "max_new_tokens": 8,
+        "state": "retired", "t_submit": 0.0, "t_admit": 0.1,
+        "t_first_token": 0.2, "t_retire": 0.6, "t_preempt": None,
+        "n_tokens": 3, "deliveries": [[0.2, 1], [0.4, 1], [0.6, 1]],
+        "queue_wait_s": 0.1, "ttft_s": 0.2, "tpot_s": 0.2, "e2e_s": 0.6,
+    }
+    row.update(kw)
+    return row
+
+
+def _snap(*rows):
+    return {"schema": "dls.requests/1", "requests": list(rows),
+            "evicted": 0}
+
+
+def test_lifecycle_clean_rows_and_validator_agreement():
+    retired = _row()
+    preempted = _row(rid="r1", state="preempted", t_retire=None,
+                     t_preempt=0.5, e2e_s=None, tpot_s=None)
+    shed = _row(rid="r2", state="shed", t_admit=None, t_first_token=None,
+                t_retire=None, n_tokens=0, deliveries=[],
+                queue_wait_s=None, ttft_s=None, tpot_s=None, e2e_s=None)
+    # ties are legal: the virtual clock stamps coalesced events equally
+    tied = _row(rid="r3", t_first_token=0.1, t_retire=0.1,
+                deliveries=[[0.1, 1], [0.1, 2]])
+    rep = analyze_lifecycle([retired, preempted, shed, tied], final=True)
+    assert rep.diagnostics == [], [d.render() for d in rep.diagnostics]
+    # the engine-schema validator agrees on its (shed-free) subset
+    assert validate_request_log(_snap(retired, preempted, tied)) == []
+
+
+def test_lifecycle_illegal_transitions_lcy001():
+    # first token without admission
+    rep = analyze_lifecycle(
+        [_row(t_admit=None, queue_wait_s=None)], final=True)
+    assert {d.code for d in rep.diagnostics} == {"LCY001"}
+    # a preempted record must not carry t_retire — and the reqlog
+    # validator rejects the same row for the same reason
+    bad = _row(state="preempted", t_preempt=0.5)
+    rep = analyze_lifecycle([bad], final=True)
+    assert any(d.code == "LCY001" for d in rep.diagnostics)
+    assert any("t_retire" in e for e in validate_request_log(_snap(bad)))
+
+
+def test_lifecycle_time_travel_lcy002_matches_validator():
+    bad = _row(t_retire=0.05, e2e_s=0.05, deliveries=[[0.2, 3]])
+    rep = analyze_lifecycle([bad], final=True)
+    msgs = [d.message for d in rep.diagnostics if d.code == "LCY002"]
+    assert msgs, [d.render() for d in rep.diagnostics]
+    # the message text comes from the SHARED helper, so the validator
+    # flags the identical violation wording
+    verrs = validate_request_log(_snap(bad))
+    assert any(m.split(": ", 1)[-1] in e for m in msgs for e in verrs)
+
+
+def test_lifecycle_unknown_state_lcy004():
+    bad = _row(state="vanished")
+    rep = analyze_lifecycle([bad], final=True)
+    assert {d.code for d in rep.diagnostics} == {"LCY004"}
+    assert any("unknown state" in e for e in validate_request_log(_snap(bad)))
+    rep = analyze_lifecycle(["not-a-record"], final=True)
+    assert {d.code for d in rep.diagnostics} == {"LCY004"}
+
+
+def test_lifecycle_terminal_exhaustiveness_lcy003():
+    live = _row(state="decoding", t_retire=None, e2e_s=None, tpot_s=None)
+    assert analyze_lifecycle([live], final=False).diagnostics == []
+    rep = analyze_lifecycle([live], final=True)
+    assert {d.code for d in rep.diagnostics} == {"LCY003"}
+
+
+def test_lifecycle_token_accounting_lcy005():
+    bad = _row(n_tokens=7)
+    rep = analyze_lifecycle([bad], final=True)
+    assert {d.code for d in rep.diagnostics} == {"LCY005"}
+    assert any("n_tokens" in e for e in validate_request_log(_snap(bad)))
+    # tokens counted but no delivery evidence
+    rep = analyze_lifecycle([_row(deliveries=None)], final=True)
+    assert {d.code for d in rep.diagnostics} == {"LCY005"}
+
+
+def test_lifecycle_accepts_live_request_log_object():
+    log = RequestLog()
+    log.submit("a", 8, 4, 0.0)
+    log.admit("a", 0.1)
+    log.first_token("a", 0.2)
+    log.deliver("a", 0.4, 3)
+    log.retire("a", 0.4)
+    assert analyze_lifecycle(log, final=True).diagnostics == []
+    log.submit("b", 8, 4, 0.5)      # still queued: fine live, not final
+    assert analyze_lifecycle(log, final=False).diagnostics == []
+    rep = analyze_lifecycle(log, final=True, label="live")
+    assert [d.code for d in rep.diagnostics] == ["LCY003"]
+    assert rep.diagnostics[0].message.startswith("live: ")
+
+
+@pytest.mark.parametrize(
+    "fixture,code,count",
+    [
+        ("det001_clock.py", "DET001", 3),
+        ("serve/det002_rng.py", "DET002", 2),
+        ("det003_setiter.py", "DET003", 2),
+        ("det004_idkey.py", "DET004", 3),
+        ("det005_env.py", "DET005", 3),
+    ],
+)
+def test_determinism_fixture_fires(fixture, code, count):
+    rep = analyze_determinism(paths=[_FIXTURES / fixture])
+    codes = [d.code for d in rep.diagnostics]
+    assert codes == [code] * count, [d.render() for d in rep.diagnostics]
+    assert all(d.severity == Severity.ERROR for d in rep.diagnostics)
+
+
+def test_determinism_markers_suppress():
+    rep = analyze_determinism(paths=[_FIXTURES / "markered_clean.py"])
+    assert rep.diagnostics == [], [d.render() for d in rep.diagnostics]
+
+
+def test_determinism_repo_tree_is_clean():
+    """The repo-wide gate: every wall-clock/RNG/env/set-order hazard in
+    the package is either fixed or carries an inline justification."""
+    rep = analyze_determinism()
+    assert rep.diagnostics == [], [d.render() for d in rep.diagnostics]
+
+
+def test_analyze_wires_serving_passes_through():
+    g = TaskGraph([Task("t1", 1.0, 2.0, [], set())]).freeze()
+    rep = analyze(
+        g,
+        page_events=[{"seq": 0, "kind": "alloc", "pages": [3],
+                      "owner": None, "site": None, "free_pages": 4,
+                      "used_pages": 1}],
+        request_log=[_row(state="decoding", t_retire=None, e2e_s=None,
+                          tpot_s=None)],
+        request_log_final=True,
+    )
+    codes = {d.code for d in rep.diagnostics}
+    assert "PGL001" in codes and "LCY003" in codes
+    assert codes <= set(CODES)

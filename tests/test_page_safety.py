@@ -1,0 +1,268 @@
+"""Page-lifetime prover (analysis/page_pass.py) + ownership seam.
+
+Three layers under test:
+
+* the recording seam itself — ``PagePool`` appends alloc/free events
+  with post-event tiling counts, ``PagedDecodeEngine`` appends
+  owner-attributed assign/release events at its lifecycle edges, and
+  with no log attached the engine is bitwise-identical to the
+  uninstrumented one (the memprof zero-overhead contract);
+* the prover — golden repros for each PGL code over synthetic event
+  streams, plus the bare-``PagePool`` runtime guards those codes
+  mirror;
+* the headline claim — the ``_LeakyPool`` soak injector is caught
+  *statically* from one short serving run: PGL001 with the owning rid
+  and alloc site, no hour of soak required.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_scheduler_tpu.analysis import (
+    analyze_pages,
+    analyze_serve_artifact,
+)
+from distributed_llm_scheduler_tpu.models.kv_pages import (
+    PageOwnershipLog,
+    PagePool,
+)
+from distributed_llm_scheduler_tpu.serve.frontend import VirtualClock
+from distributed_llm_scheduler_tpu.serve.soak import inject_page_leak
+
+PROMPT = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+
+
+def _codes(rep):
+    return [d.code for d in rep.diagnostics]
+
+
+# -- the recording seam ----------------------------------------------------
+def test_pool_records_alloc_free_with_tiling_counts():
+    pool = PagePool(n_pages=6, page_size=4)
+    log = PageOwnershipLog(n_pages=pool.n_pages)
+    pool.ownlog = log
+    a = pool.alloc(2)
+    b = pool.alloc(1)
+    pool.free(a)
+    pool.free(b)
+    kinds = [e["kind"] for e in log.events]
+    assert kinds == ["alloc", "alloc", "free", "free"]
+    assert [e["seq"] for e in log.events] == [0, 1, 2, 3]
+    for e in log.events:
+        assert e["free_pages"] + e["used_pages"] == pool.n_pages - 1
+    snap = log.snapshot()
+    assert snap["schema"] == "dls.pages/1"
+    assert snap["n_pages"] == 6
+    # a fully paired stream replays clean, tiling proven at every event
+    assert analyze_pages(log).diagnostics == []
+    assert analyze_pages(snap).diagnostics == []
+
+
+def test_bare_pool_runtime_guards():
+    """The prover's PGL002/PGL004 codes mirror guards the pool already
+    enforces at runtime — double-free and trash-page free both raise."""
+    pool = PagePool(n_pages=6, page_size=4)
+    pages = pool.alloc(1)
+    pool.free(pages)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(pages)
+    with pytest.raises(ValueError, match="reserved"):
+        pool.free([0])
+
+
+# -- golden per-code repros over synthetic streams -------------------------
+def _ev(seq, kind, pages, **kw):
+    e = {"seq": seq, "kind": kind, "pages": list(pages),
+         "owner": None, "site": None, "free_pages": None,
+         "used_pages": None}
+    e.update(kw)
+    return e
+
+
+def test_pgl001_orphan_names_owner_and_alloc_site():
+    rep = analyze_pages([
+        _ev(0, "alloc", [3], free_pages=4, used_pages=1),
+        _ev(1, "assign", [3], owner="r7", site="admit"),
+    ], n_pages=6)
+    assert _codes(rep) == ["PGL001"]
+    d = rep.diagnostics[0]
+    assert d.task == "r7"
+    assert "allocated at event 0" in d.message
+    assert "site=admit" in d.message
+    assert d.data["page"] == 3 and d.data["owner"] == "r7"
+
+
+def test_pgl001_suppressed_for_mid_run_snapshots():
+    stream = [_ev(0, "alloc", [3], free_pages=4, used_pages=1)]
+    assert _codes(analyze_pages(stream, n_pages=6, final=False)) == []
+    assert _codes(analyze_pages(stream, n_pages=6)) == ["PGL001"]
+
+
+def test_pgl002_double_free():
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "free", [2], free_pages=5, used_pages=0),
+        _ev(2, "free", [2], free_pages=5, used_pages=0),
+    ], n_pages=6)
+    assert _codes(rep) == ["PGL002"]
+    assert "double-free of page 2" in rep.diagnostics[0].message
+
+
+def test_pgl003_freed_while_owner_live():
+    rep = analyze_pages([
+        _ev(0, "alloc", [4], free_pages=4, used_pages=1),
+        _ev(1, "assign", [4], owner="r1", site="admit"),
+        _ev(2, "free", [4], free_pages=5, used_pages=0),
+    ], n_pages=6)
+    assert _codes(rep) == ["PGL003"]
+    assert "live owner 'r1'" in rep.diagnostics[0].message
+
+
+def test_pgl004_trash_page_crossed_allocator():
+    rep = analyze_pages([
+        _ev(0, "alloc", [0, 2], free_pages=3, used_pages=2),
+        _ev(1, "free", [0, 2], free_pages=5, used_pages=0),
+    ], n_pages=6, final=False)
+    assert _codes(rep).count("PGL004") == 2
+
+
+def test_pgl005_protocol_and_tiling_violations():
+    # assign without a covering alloc
+    rep = analyze_pages(
+        [_ev(0, "assign", [2], owner="r1", site="admit")],
+        n_pages=6, final=False)
+    assert "PGL005" in _codes(rep)
+    # second live owner
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "assign", [2], owner="r1", site="admit"),
+        _ev(2, "assign", [2], owner="r2", site="admit"),
+    ], n_pages=6, final=False)
+    assert "PGL005" in _codes(rep)
+    # release by a non-owner
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "assign", [2], owner="r1", site="admit"),
+        _ev(2, "release", [2], owner="r2", site="retire"),
+    ], n_pages=6, final=False)
+    assert "PGL005" in _codes(rep)
+    # free list + allocated set stop tiling the pool
+    rep = analyze_pages(
+        [_ev(0, "alloc", [2], free_pages=3, used_pages=1)],
+        n_pages=6, final=False)
+    assert "PGL005" in _codes(rep)
+    # unknown event kind
+    rep = analyze_pages([_ev(0, "mystery", [2])], n_pages=6,
+                        final=False)
+    assert _codes(rep) == ["PGL005"]
+
+
+# -- the engine seam end-to-end --------------------------------------------
+def test_clean_run_replays_clean_with_tiling_proven(session_serve_engine):
+    eng = session_serve_engine
+    log = PageOwnershipLog()
+    eng.rebind_obs(clock=VirtualClock(), ownlog=log)
+    eng.submit("a", PROMPT, 16)
+    eng.submit("b", PROMPT, 16)
+    eng.step_segment()
+    eng.preempt("a")                      # exercise the preempt edge too
+    eng.run()
+    assert len(log) > 0
+    kinds = {e["kind"] for e in log.events}
+    assert {"alloc", "assign", "release", "free"} <= kinds
+    assert any(e["site"] == "preempt" for e in log.events)
+    for e in log.events:
+        if e["kind"] in ("alloc", "free"):
+            assert e["free_pages"] + e["used_pages"] == log.n_pages - 1
+        else:
+            assert e["owner"] is not None
+    assert analyze_pages(log).diagnostics == []
+
+
+def test_leaky_pool_caught_statically(session_serve_engine):
+    """The tentpole claim: the soak fault injector is convicted by the
+    prover from one short run — PGL001 per withheld page, each naming
+    the owning rid and the alloc event."""
+    eng = session_serve_engine
+    log = PageOwnershipLog()
+    eng.rebind_obs(clock=VirtualClock(), ownlog=log)
+    leaky = inject_page_leak(eng, 1)      # withhold on every free
+    eng.submit("victim", PROMPT, 16)
+    eng.run()
+    assert len(leaky.withheld) >= 1
+    rep = analyze_pages(log)
+    assert rep.exit_code == 1
+    assert set(_codes(rep)) == {"PGL001"}
+    assert len(rep.diagnostics) == len(leaky.withheld)
+    for d in rep.diagnostics:
+        assert d.task == "victim"
+        assert "site=admit" in d.message
+
+
+def test_seam_off_is_bitwise_identical(session_serve_engine):
+    """Zero-overhead contract: the same workload with and without the
+    ownership log attached produces bit-identical tokens, occupancy,
+    and request-log snapshots."""
+    eng = session_serve_engine
+
+    def run(ownlog):
+        eng.rebind_obs(clock=VirtualClock(), ownlog=ownlog)
+        eng.submit("a", PROMPT, 16)
+        eng.submit("b", PROMPT, 8)
+        out = eng.run()
+        return (
+            {k: np.asarray(v) for k, v in out.items()},
+            eng.page_occupancy(),
+            eng.reqlog.snapshot(),
+        )
+
+    out_off, occ_off, snap_off = run(None)
+    log = PageOwnershipLog()
+    out_on, occ_on, snap_on = run(log)
+    assert len(log) > 0                   # the seam did record
+    assert out_off.keys() == out_on.keys()
+    for k in out_off:
+        assert np.array_equal(out_off[k], out_on[k])
+    assert occ_off == occ_on
+    assert snap_off == snap_on
+
+
+def test_rebind_detaches_stale_log(session_serve_engine):
+    eng = session_serve_engine
+    log = PageOwnershipLog()
+    eng.rebind_obs(clock=VirtualClock(), ownlog=log)
+    assert eng.ownlog is log and eng.pool.ownlog is log
+    eng.rebind_obs(clock=VirtualClock())  # default ownlog=None detaches
+    assert eng.ownlog is None and eng.pool.ownlog is None
+    eng.submit("a", PROMPT, 8)
+    eng.run()
+    assert len(log) == 0                  # stale log saw nothing
+
+
+# -- the offline artifact gate ---------------------------------------------
+def test_artifact_gate_flags_leak_counter_and_embedded_events():
+    art = {
+        "schema": "dls.serve/1",
+        "legs": {
+            "clean": {"pages_leaked": 0},
+            "leaky": {"pages_leaked": 2},
+            "embedded": {
+                "pages_leaked": 0,
+                "page_events": [
+                    _ev(0, "alloc", [3], free_pages=4, used_pages=1),
+                    _ev(1, "assign", [3], owner="r9", site="admit"),
+                ],
+            },
+        },
+    }
+    rep = analyze_serve_artifact(art)
+    assert _codes(rep).count("PGL001") == 2
+    assert any(d.task == "leaky" for d in rep.diagnostics)
+    assert any(d.task == "r9" for d in rep.diagnostics)
+
+    soak = {"schema": "dls.soak/1", "serving": {"pages_leaked": 3}}
+    assert _codes(analyze_serve_artifact(soak)) == ["PGL001"]
+
+    with pytest.raises(ValueError, match="serve/soak artifact"):
+        analyze_serve_artifact({"schema": "dls.metrics/1"})

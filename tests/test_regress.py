@@ -38,6 +38,14 @@ BASE = {
     "decode.kernel_tokens_exact": True,
     "decode.kernel_parity_ok": True,
     "decode.kernel_pages_leaked": 0,
+    "search.makespan_ms": 1.6768,
+    "search.replay_ms": 1.6768,
+    "search.margin_vs_hand_pct": 0.65,
+    "search.ici_slow_margin_pct": 0.66,
+    "search.ici_fast_margin_pct": 0.64,
+    "search.beats_hand": True,
+    "search.beats_ici_extreme": True,
+    "search.placement_digest": "d0f9c4",
 }
 
 
