@@ -188,15 +188,26 @@ def _cmd_lint_serving(args) -> int:
     (PGL00x) over the recorded event stream, the request-lifecycle
     checker (LCY00x) over both the frontend's rows and the engine's
     reqlog, and the repo-wide determinism lint (DET00x).
+    ``--prefix`` serves the shared-prefix session workload on a
+    sharing-enabled engine instead, so the prover replays the
+    ref-counted share/unshare/cow/write lattice (PGL006/PGL007).
     ``--inject-leak N`` swaps in the leaky-pool fault injector (the CI
-    must-fail leg: exit 1 naming PGL001)."""
+    must-fail leg: exit 1 naming PGL001); ``--inject-underflow`` (with
+    ``--prefix``) swaps in the refcount-underflow injector (exit 1
+    naming PGL006)."""
+    import functools
+
     from .analysis import (
         Severity,
         analyze_determinism,
         analyze_lifecycle,
         analyze_pages,
     )
-    from .eval.serve_bench import SCENARIO, build_serve_engine
+    from .eval.serve_bench import (
+        PREFIX_SCENARIO,
+        SCENARIO,
+        build_serve_engine,
+    )
     from .models.kv_pages import PageOwnershipLog
     from .obs.slo import SLOPolicy
     from .serve.frontend import (
@@ -204,30 +215,55 @@ def _cmd_lint_serving(args) -> int:
         ServingFrontend,
         VirtualClock,
     )
-    from .serve.loadgen import poisson_arrivals
-    from .serve.soak import inject_page_leak
+    from .serve.loadgen import (
+        poisson_arrivals,
+        session_arrivals,
+        session_prompt_token_ids,
+    )
+    from .serve.soak import inject_page_leak, inject_refcount_underflow
 
     if args.inject_leak is not None and args.inject_leak < 1:
         print(f"--inject-leak must be >= 1, got {args.inject_leak}",
               file=sys.stderr)
         return 2
-    sc = SCENARIO
-    arrivals = poisson_arrivals(
-        sc["rate_rps"], sc["n_requests"], args.seed,
-        prompt_lens=sc["prompt_lens"],
-        max_new_tokens=sc["max_new_tokens"],
-        priorities=sc["priorities"],
-        priority_weights=sc["priority_weights"],
-    )
+    prefix = bool(getattr(args, "prefix", False))
+    prompt_fn = None
+    if prefix:
+        sc = dict(SCENARIO, **PREFIX_SCENARIO)
+        arrivals = session_arrivals(
+            sc["prefix_rate_rps"], sc["n_sessions"], args.seed,
+            system_len=sc["system_len"], user_len=sc["user_len"],
+            turns=sc["turns"],
+            max_new_tokens=sc["prefix_max_new_tokens"],
+            priorities=sc["priorities"],
+            priority_weights=sc["priority_weights"],
+            think_time_s=sc["think_time_s"],
+        )
+        prompt_fn = functools.partial(
+            session_prompt_token_ids,
+            system_len=sc["system_len"], user_len=sc["user_len"],
+        )
+    else:
+        sc = SCENARIO
+        arrivals = poisson_arrivals(
+            sc["rate_rps"], sc["n_requests"], args.seed,
+            prompt_lens=sc["prompt_lens"],
+            max_new_tokens=sc["max_new_tokens"],
+            priorities=sc["priorities"],
+            priority_weights=sc["priority_weights"],
+        )
     eng, _pool = build_serve_engine(
         slots=sc["slots"], page_size=sc["page_size"],
         n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
         seg_steps=sc["seg_steps"], clock=VirtualClock(),
+        sharing=prefix,
     )
     ownlog = PageOwnershipLog()
     eng.attach_ownership_log(ownlog)
     if args.inject_leak is not None:
         inject_page_leak(eng, args.inject_leak)
+    if getattr(args, "inject_underflow", False):
+        inject_refcount_underflow(eng)
     fe = ServingFrontend(
         eng, arrivals,
         SLOPolicy(ttft_s=sc["ttft_s"], window_s=sc["window_s"],
@@ -237,6 +273,7 @@ def _cmd_lint_serving(args) -> int:
             wave_s=sc["wave_s"], segment_s=sc["segment_s"],
             idle_s=sc["idle_s"],
         ),
+        prompt_fn=prompt_fn,
     )
     fe.run()
     rep = analyze_determinism()
@@ -253,12 +290,22 @@ def _cmd_lint_serving(args) -> int:
     print(rep.render(min_severity=min_sev))
     if not rep.diagnostics:
         n_pool = sum(
-            1 for e in ownlog.events if e["kind"] in ("alloc", "free")
+            1 for e in ownlog.events
+            if e["kind"] in ("alloc", "free", "share", "unshare")
+        )
+        shared = sum(
+            len(e["pages"]) for e in ownlog.events
+            if e["kind"] == "share"
+        )
+        extra = (
+            f" ({shared} shared-page references ref-counted)"
+            if prefix else ""
         )
         print(
             f"serving lint clean: {len(ownlog)} ownership events "
             f"replayed, free+used tiling proven at all {n_pool} pool "
-            "events; lifecycle and determinism passes found nothing",
+            f"events{extra}; lifecycle and determinism passes found "
+            "nothing",
             file=sys.stderr,
         )
     return rep.exit_code
@@ -275,12 +322,24 @@ def cmd_lint(args) -> int:
         if args.parallel or args.decode or args.paged or args.preflight \
                 or args.fix:
             print("--serving runs the serving-safety passes and combines "
-                  "only with --json/--verbose/--inject-leak/--seed",
+                  "only with --json/--verbose/--prefix/--inject-leak/"
+                  "--inject-underflow/--seed",
+                  file=sys.stderr)
+            return 2
+        if getattr(args, "inject_underflow", False) \
+                and not getattr(args, "prefix", False):
+            print("--inject-underflow needs the sharing-enabled workload: "
+                  "use lint --serving --prefix --inject-underflow",
                   file=sys.stderr)
             return 2
         return _cmd_lint_serving(args)
     if getattr(args, "inject_leak", None) is not None:
         print("--inject-leak only applies to lint --serving",
+              file=sys.stderr)
+        return 2
+    if getattr(args, "prefix", False) \
+            or getattr(args, "inject_underflow", False):
+        print("--prefix/--inject-underflow only apply to lint --serving",
               file=sys.stderr)
         return 2
 
@@ -2062,11 +2121,22 @@ def main(argv=None) -> int:
                         "request-lifecycle checker (LCY00x) over frontend "
                         "+ engine logs, repo-wide determinism lint "
                         "(DET00x)")
+    p.add_argument("--prefix", action="store_true",
+                   help="with --serving: serve the shared-prefix session "
+                        "workload on a sharing-enabled engine so the "
+                        "prover replays the ref-counted "
+                        "share/unshare/cow/write lattice "
+                        "(PGL006/PGL007)")
     p.add_argument("--inject-leak", type=int, default=None,
                    dest="inject_leak", metavar="N",
                    help="with --serving: withhold one page from every "
                         "Nth free (the leaky-pool fault injector) — the "
                         "prover must exit 1 naming PGL001")
+    p.add_argument("--inject-underflow", action="store_true",
+                   dest="inject_underflow",
+                   help="with --serving --prefix: lose one reference per "
+                        "share (the refcount-underflow fault injector) — "
+                        "the prover must exit 1 naming PGL006")
     p.add_argument("--decode", action="store_true",
                    help="lint the single-token decode-step DAG instead of "
                         "the full forward")

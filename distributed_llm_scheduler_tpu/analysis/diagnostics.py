@@ -112,6 +112,8 @@ CODES: Dict[str, str] = {
     "PGL003": "page freed while still referenced by a live page table",
     "PGL004": "reserved trash page crossed the allocator",
     "PGL005": "pool accounting mismatch: free + used do not tile the pool",
+    "PGL006": "refcount underflow/overflow on a shared page",
+    "PGL007": "write or cow split violates copy-on-write discipline",
     # -- request-lifecycle protocol (lifecycle_pass) --------------------
     "LCY001": "illegal lifecycle transition (state/timestamp mismatch)",
     "LCY002": "non-monotone per-request timestamps (time travel)",

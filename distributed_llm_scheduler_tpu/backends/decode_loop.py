@@ -496,12 +496,7 @@ class PagedDecodeEngine:
         np = self._np
         for s, pages in enumerate(self._slot_pages):
             if pages:
-                if self.ownlog is not None:
-                    self.ownlog.record(
-                        "release", pages,
-                        owner=str(self._slot_req[s]), site="reset",
-                    )
-                self.pool.free(pages)
+                self._release_pages(pages, str(self._slot_req[s]), "reset")
                 if self.memprof is not None:
                     self.memprof.free(
                         self._mem_node, f"kv:{self._slot_req[s]}"
@@ -587,13 +582,118 @@ class PagedDecodeEngine:
         # bookkeeping and swap in a pristine pool of the same geometry
         self._slot_pages = [[] for _ in range(self.slots)]
         self.pool = PagePool(
-            n_pages=self.pool.n_pages, page_size=self.pool.page_size
+            n_pages=self.pool.n_pages, page_size=self.pool.page_size,
+            sharing=bool(getattr(self.pool, "sharing", False)),
         )
         self.attach_ownership_log(ownlog)
         self.__dict__.pop("step_segment", None)
         # reset() rebuilds pools/tables/reqlog against the just-bound
         # clock and flight sinks
         self.reset()
+
+    # -- prefix sharing ----------------------------------------------------
+    @property
+    def sharing(self) -> bool:
+        """Whether the pool interns prefix chunks (read live off the
+        pool, so ``rebind_obs``'s pristine replacement keeps the mode)."""
+        return bool(getattr(self.pool, "sharing", False))
+
+    def _release_pages(self, pages, owner: str, site: str) -> None:
+        """The ONE page-release path for retire/preempt/reset: records
+        the owner-attributed ``release`` (with live refcounts when
+        sharing), then drops the reference — last release frees
+        physically, earlier ones only decrement.  With sharing off this
+        is byte-for-byte the pre-sharing record+free sequence."""
+        if self.sharing:
+            if self.ownlog is not None:
+                self.ownlog.record(
+                    "release", pages, owner=owner, site=site,
+                    refcounts=[self.pool.refcount(p) for p in pages],
+                )
+            self.pool.release_ref(pages)
+        else:
+            if self.ownlog is not None:
+                self.ownlog.record(
+                    "release", pages, owner=owner, site=site,
+                )
+            self.pool.free(pages)
+
+    def fresh_pages_needed(self, prompt_ids: Any, max_new_tokens: int) -> int:
+        """Pages a request would newly allocate if admitted NOW: its
+        ``prompt + max_new`` footprint minus currently-resident shared
+        prefix chunks.  The serving frontend's admission check calls
+        this so backlog ordering sees the same headroom admission will.
+        With sharing off it is exactly ``pages_needed``."""
+        from ..models.kv_pages import pages_needed, prefix_chunk_keys
+
+        P = int(prompt_ids.shape[1])
+        need = pages_needed(P + max_new_tokens, self.page_size)
+        if not self.sharing:
+            return need
+        h_max = (P - 1) // self.page_size
+        keys = prefix_chunk_keys(
+            prompt_ids, self.page_size
+        )[:h_max]
+        h, _ = self.pool.match_prefix(keys)
+        return need - h
+
+    def _ensure_exclusive(self) -> None:
+        """Copy-on-write guard before a segment: any page the coming
+        writes would land in while other requests still alias it is
+        split — a fresh page is allocated, the content copied on device,
+        and the shared reference released (alloc-before-release, the
+        ordering PGL007 proves).  Structurally unreachable under the
+        admission rule (generation always lands in exclusive tail
+        pages), but the seam is real: tests force an alias onto a write
+        page and the split must keep every request's tokens bitwise."""
+        if not self.sharing:
+            return
+        np = self._np
+        for s in range(self.slots):
+            if self._slot_req[s] is None or self.remaining[s] <= 0:
+                continue
+            lo = int(self.lengths[s])
+            hi = lo + min(int(self.remaining[s]), self.seg_steps)
+            for li in range(lo // self.page_size,
+                            (hi - 1) // self.page_size + 1):
+                src = int(self.page_table[s, li])
+                if self.pool.refcount(src) <= 1:
+                    continue
+                dst = self.pool.alloc(1)[0]
+                rid = str(self._slot_req[s])
+                if self.ownlog is not None:
+                    self.ownlog.record(
+                        "cow", [src, dst], owner=rid, site="cow",
+                        refcounts=[self.pool.refcount(src),
+                                   self.pool.refcount(dst)],
+                    )
+                self.pools = self._cow_copy(
+                    self.pools, jnp.int32(src), jnp.int32(dst)
+                )
+                self.page_table[s, li] = dst
+                pages = self._slot_pages[s]
+                pages[pages.index(src)] = dst
+                self.pool.release_ref([src])
+                if self.ownlog is not None:
+                    self.ownlog.record(
+                        "write", [dst], owner=rid, site="cow",
+                        refcounts=[self.pool.refcount(dst)],
+                    )
+                self.metrics.counter("decode.cow_splits").inc()
+
+    @property
+    def _cow_copy(self):
+        fn = self._prefill_store.get("cow_copy")
+        if fn is None:
+            def _fn(pools, src, dst):
+                new = dict(pools)
+                for k in new:
+                    new[k] = new[k].at[dst].set(new[k][src])
+                return new
+
+            fn = jax.jit(_fn, donate_argnums=(0,))
+            self._prefill_store["cow_copy"] = fn
+        return fn
 
     # -- request intake ----------------------------------------------------
     def _emit_queue_depth(self) -> None:
@@ -621,12 +721,27 @@ class PagedDecodeEngine:
             for s in range(self.slots)
             if self._slot_req[s] is not None
         }
-        return {
+        occ = {
             "n_pages": self.pool.n_pages - 1,  # page 0 is the trash page
             "free_pages": self.pool.free_pages,
             "used_pages": self.pool.used_pages,
             "per_request": per_request,
         }
+        if self.sharing:
+            # logical-vs-physical accounting exists only in sharing mode:
+            # the disabled engine's occupancy dict stays bitwise-identical
+            # to the pre-sharing one
+            occ["logical_pages"] = self.pool.logical_pages
+            occ["shared_pages"] = self.pool.shared_pages
+            occ["per_request_exclusive"] = {
+                str(self._slot_req[s]): sum(
+                    1 for p in self._slot_pages[s]
+                    if self.pool.refcount(p) == 1
+                )
+                for s in range(self.slots)
+                if self._slot_req[s] is not None
+            }
+        return occ
 
     def _emit_pool_occupancy(self) -> None:
         """Sample :meth:`page_occupancy` into the ``decode.page_pool``
@@ -652,7 +767,7 @@ class PagedDecodeEngine:
     def summary(self) -> Dict[str, Any]:
         """Engine-state snapshot: slot/queue/pool headroom at this
         segment boundary (what admission policies key off)."""
-        return {
+        out = {
             "slots": self.slots,
             "free_slots": self.free_slots,
             "queued": len(self._queue),
@@ -662,6 +777,9 @@ class PagedDecodeEngine:
             "attention_impl": self.attention_impl or "auto",
             "page_occupancy": self.page_occupancy(),
         }
+        if self.sharing:
+            out["prefix_sharing"] = True
+        return out
 
     def submit(self, rid: Any, prompt_ids: Any, max_new_tokens: int) -> None:
         """Queue a request; admitted into a free slot (and its pages
@@ -758,6 +876,94 @@ class PagedDecodeEngine:
         first, self.pools = fn(prompt_ids, self.pools, jnp.asarray(pt_rows))
         return first
 
+    def _prefill_scatter_shared(
+        self, prompt_ids: jax.Array, h: int, shared_rows, wt_rows
+    ):
+        """Stitched prefill for a wave whose first ``h`` prefix pages are
+        already resident: gather the shared pages into the dense cache,
+        run the transformer over ONLY the tail ``[h*ps, P)`` at
+        ``pos_start = h*ps``, and scatter through the write table (shared
+        entries diverted to the trash page, so aliased content is never
+        re-written).
+
+        Bitwise contract: ``cached_attention`` masks cache columns
+        beyond the write cursor AFTER computing scores, so masked
+        operand values never reach the output — the same property the
+        preemption-resume path proves cross-shape.  Resident rows are
+        bitwise what a full prefill would have produced (KV at position
+        j depends only on tokens[0..j]), the tail runs the identical
+        ``forward_cached`` at a later ``pos_start``, and rows past P
+        stay zero exactly as in the unshared path — so first token,
+        scattered pages, and every subsequent decode step match the
+        unshared run bit for bit.
+
+        ``prompt_ids`` (b, P) FULL prompts (the resident portion is
+        sliced off here, keeping the caller symmetric with
+        :meth:`_prefill_scatter`); ``shared_rows`` (b, h) physical ids
+        of the resident prefix pages; ``wt_rows`` (b, pages_per_seq)
+        the write table.  One compile class per ``(P, h, b, impl)``.
+        """
+        from ..frontend.decode_dag import cache_dims as _cd
+        from ..models import decode as _decode
+        from ..parallel.decode import _family_of, _module_for
+
+        b, P = prompt_ids.shape
+        h = int(h)
+        key = ("shared", P, h, b, self.attention_impl)
+        fn = self._prefill_store.get(key)
+        if fn is None:
+            mod = _module_for(_family_of(self.config))
+            n_layers, n_kv, hd = _cd(self.config)
+            cap, cfg = self.capacity, self.config
+            ppseq, ps = self.pages_per_seq, self.page_size
+            pre = h * ps
+
+            w = self.weights  # bound constants, same as the segment fn
+
+            def _fn(ids_tail, pools, spages, wpages):
+                cache = _decode.init_cache(
+                    n_layers, b, n_kv, cap, hd, cfg.dtype
+                )
+                flat_sh = spages.reshape(b * h)
+                for i in range(n_layers):
+                    for kind in ("k", "v"):
+                        poolarr = pools[f"cache_{kind}_{i}"]
+                        rows = jnp.take(poolarr, flat_sh, axis=0)
+                        rows = rows.reshape(b, pre, n_kv, hd)
+                        rows = rows.transpose(0, 2, 1, 3)  # (b,Hkv,pre,hd)
+                        buf = cache[kind]
+                        cache[kind] = buf.at[i, :, :, :pre, :].set(
+                            rows.astype(buf.dtype)
+                        )
+                logits, cache = mod.forward_cached(
+                    w, ids_tail, cache, pre, cfg
+                )
+                first = jnp.argmax(
+                    logits[:, -1, :], axis=-1
+                ).astype(jnp.int32)
+                flat_pages = wpages.reshape(b * ppseq)
+                new = dict(pools)
+                for i in range(n_layers):
+                    for kind in ("k", "v"):
+                        rows = cache[kind][i].transpose(0, 2, 1, 3)
+                        paged = rows.reshape(b * ppseq, ps, n_kv, hd)
+                        pool = new[f"cache_{kind}_{i}"]
+                        new[f"cache_{kind}_{i}"] = pool.at[flat_pages].set(
+                            paged.astype(pool.dtype), mode="drop"
+                        )
+                return first, new
+
+            fn = jax.jit(_fn, donate_argnums=(1,))
+            self._prefill_store[key] = fn
+        if key not in self._prefill_cache:
+            self._prefill_cache[key] = fn
+        tail = prompt_ids[:, h * self.page_size:]
+        first, self.pools = fn(
+            tail, self.pools,
+            jnp.asarray(shared_rows), jnp.asarray(wt_rows),
+        )
+        return first
+
     # -- admission / retirement (between segments) -------------------------
     def _admit(self) -> int:
         """FIFO admission, batched: the longest same-prompt-length prefix
@@ -765,10 +971,21 @@ class PagedDecodeEngine:
         prefilled in one call.  Head-of-line blocking is deliberate —
         admission order stays strict FIFO (no starvation of big
         requests), batching only coalesces what FIFO would have admitted
-        anyway."""
-        from ..models.kv_pages import TRASH_PAGE, pages_needed
+        anyway.
+
+        With prefix sharing the batch key tightens to ``(P, h)``: every
+        request in a wave matches the same NUMBER of resident prefix
+        chunks (the matched page ids are data, not shape), its page need
+        drops to the fresh tail only, and the wave runs the stitched
+        prefill that skips the resident portion entirely."""
+        from ..models.kv_pages import (
+            TRASH_PAGE,
+            pages_needed,
+            prefix_chunk_keys,
+        )
 
         admitted = 0
+        sharing = self.sharing
         while self._queue:
             free_slots = [
                 s for s in range(self.slots) if self._slot_req[s] is None
@@ -776,15 +993,32 @@ class PagedDecodeEngine:
             if not free_slots:
                 break
             P = self._queue[0][1].shape[1]
-            batch, budget = [], self.pool.free_pages
+            h0 = 0
+            if sharing:
+                h_max = (P - 1) // self.page_size
+                keys0 = prefix_chunk_keys(self._queue[0][1], self.page_size)
+                h0, _ = self.pool.match_prefix(keys0[:h_max])
+            batch, hits, budget = [], [], self.pool.free_pages
             for rid, ids, max_new in self._queue:
                 if ids.shape[1] != P or len(batch) >= len(free_slots):
                     break
-                need = pages_needed(ids.shape[1] + max_new, self.page_size)
+                if sharing:
+                    keys = prefix_chunk_keys(ids, self.page_size)
+                    h, spages = self.pool.match_prefix(keys[:h_max])
+                    if h != h0:
+                        break
+                    need = pages_needed(
+                        ids.shape[1] + max_new, self.page_size
+                    ) - h
+                else:
+                    need = pages_needed(ids.shape[1] + max_new,
+                                        self.page_size)
                 if need > budget:
                     break
                 budget -= need
                 batch.append((rid, ids, max_new, need))
+                if sharing:
+                    hits.append((spages, keys))
             if not batch:
                 break  # backpressure: head waits for frees
             del self._queue[:len(batch)]
@@ -797,27 +1031,61 @@ class PagedDecodeEngine:
             pt_rows = self._np.full(
                 (len(batch), self.pages_per_seq), TRASH_PAGE, self._np.int32
             )
+            wt_rows = sh_rows = None
+            if sharing and h0 > 0:
+                # write table: shared prefix pages divert the prefill
+                # scatter to the trash page (overwriting it is harmless
+                # by design); gather table: the resident sources
+                wt_rows = pt_rows.copy()
+                sh_rows = self._np.zeros(
+                    (len(batch), h0), self._np.int32
+                )
             page_lists = []
             for j, (rid, _, _, need) in enumerate(batch):
-                pages = self.pool.alloc(need)
+                if sharing:
+                    spages, _keys = hits[j]
+                    if spages:
+                        self.pool.share(spages)
+                    fresh = self.pool.alloc(need)
+                    pages = list(spages) + fresh
+                    if h0 > 0:
+                        wt_rows[j, :len(pages)] = (
+                            [TRASH_PAGE] * h0 + fresh
+                        )
+                        sh_rows[j] = spages
+                else:
+                    pages = self.pool.alloc(need)
                 page_lists.append(pages)
-                pt_rows[j, :need] = pages
+                pt_rows[j, :len(pages)] = pages
                 if self.memprof is not None:
                     self.memprof.alloc(
                         self._mem_node, f"kv:{rid}",
                         need * self._page_bytes, "kv_pages",
                     )
                 if self.ownlog is not None:
-                    self.ownlog.record(
-                        "assign", pages, owner=str(rid), site="admit"
-                    )
+                    if sharing:
+                        self.ownlog.record(
+                            "assign", pages, owner=str(rid), site="admit",
+                            refcounts=[
+                                self.pool.refcount(p) for p in pages
+                            ],
+                        )
+                    else:
+                        self.ownlog.record(
+                            "assign", pages, owner=str(rid), site="admit"
+                        )
             # unconditional read: t_pf0 is each batched request's
             # admission timestamp in the lifecycle log
             t_pf0 = self._clock()
-            first = self._prefill_scatter(
-                jnp.concatenate([ids for _, ids, _, _ in batch], axis=0),
-                pt_rows,
+            all_ids = jnp.concatenate(
+                [ids for _, ids, _, _ in batch], axis=0
             )
+            if sharing and h0 > 0:
+                first = self._prefill_scatter_shared(
+                    all_ids, h0, sh_rows, wt_rows
+                )
+            else:
+                first = self._prefill_scatter(all_ids, pt_rows)
             first = self._np.asarray(first)
             # first token exists NOW (the prefill's readback): the
             # admission timestamp is each request's TTFT anchor
@@ -838,6 +1106,22 @@ class PagedDecodeEngine:
                 self._slot_pages[s] = page_lists[j]
                 self._tokens[rid] = [int(first[j])]
                 self._first_tok_t[rid] = t_adm
+                if sharing:
+                    # intern every FULL prompt page (first writer wins)
+                    # so later arrivals with this prefix alias instead
+                    # of re-prefilling; the prefill physically wrote the
+                    # fresh pages, which the write witness records
+                    _spages, keys = hits[j]
+                    for i in range(P // self.page_size):
+                        self.pool.register(int(page_lists[j][i]), keys[i])
+                    if self.ownlog is not None:
+                        freshp = page_lists[j][h0:]
+                        self.ownlog.record(
+                            "write", freshp, owner=str(rid), site="admit",
+                            refcounts=[
+                                self.pool.refcount(p) for p in freshp
+                            ],
+                        )
                 # t_pf0/t_adm are the same floats the histograms see:
                 # record-derived TTFT == histogram sample, bitwise
                 for rl in self._reqlogs:
@@ -850,6 +1134,13 @@ class PagedDecodeEngine:
                     self._retire(s)
             admitted += len(batch)
             self.metrics.counter("decode.admission_waves").inc()
+            if sharing:
+                self.metrics.counter("decode.prefix_shared_pages").inc(
+                    h0 * len(batch)
+                )
+                self.metrics.counter("decode.prefix_tokens_skipped").inc(
+                    h0 * self.page_size * len(batch)
+                )
             if ev_wave is not None:
                 self.tracer.end(ev_wave)
             self._emit_pool_occupancy()
@@ -858,12 +1149,7 @@ class PagedDecodeEngine:
 
     def _retire(self, s: int) -> None:
         rid = self._slot_req[s]
-        if self.ownlog is not None:
-            self.ownlog.record(
-                "release", self._slot_pages[s], owner=str(rid),
-                site="retire",
-            )
-        self.pool.free(self._slot_pages[s])
+        self._release_pages(self._slot_pages[s], str(rid), "retire")
         if self.memprof is not None:
             self.memprof.free(self._mem_node, f"kv:{rid}")
         self.results[rid] = self._np.asarray(
@@ -924,12 +1210,7 @@ class PagedDecodeEngine:
             self._tokens.pop(rid), dtype=self._np.int32
         )
         remaining = int(self.remaining[slot])
-        if self.ownlog is not None:
-            self.ownlog.record(
-                "release", self._slot_pages[slot], owner=str(rid),
-                site="preempt",
-            )
-        self.pool.free(self._slot_pages[slot])
+        self._release_pages(self._slot_pages[slot], str(rid), "preempt")
         if self.memprof is not None:
             self.memprof.free(self._mem_node, f"kv:{rid}")
         self.page_table[slot] = TRASH_PAGE
@@ -960,6 +1241,7 @@ class PagedDecodeEngine:
         owed = self.remaining.copy()
         if not owed.any():
             return 0
+        self._ensure_exclusive()
         t_sg0 = self._clock()
         toks, self.pools = self._seg(
             self.pools, self.page_table, self.lengths,

@@ -41,6 +41,8 @@ LOWER_BETTER = (
     "fence_rtt_ms",
     "serve.ttft_p99_ms",
     "serve.queue_wait_p95_ms",
+    "serve.prefix.ttft_p99_ms",
+    "serve.prefix.pages_leaked",
     # soak health slopes (dls.soak/1 artifact): clamped to >= 0, a
     # healthy run sits at or near 0 — any growth is a leak/degradation
     "soak.page_leak_slope_pages_s",
@@ -79,6 +81,13 @@ METRIC_DEFAULT_TOLERANCES = {
     "serve.goodput_tok_s": 0.0,
     "serve.ttft_p99_ms": 0.0,
     "serve.queue_wait_p95_ms": 0.0,
+    # the shared-prefix legs ride the same VirtualClock: goodput, tail
+    # latency, aliasing hit counts, and leak counts are all exact
+    "serve.prefix.goodput_tok_s": 0.0,
+    "serve.prefix.ttft_p99_ms": 0.0,
+    "serve.prefix.goodput_gain": 0.0,
+    "serve.prefix.shared_page_hits": 0.0,
+    "serve.prefix.pages_leaked": 0.0,
     # soak slopes share the serve bench's VirtualClock determinism: the
     # timestamps and token counts behind every Theil-Sen fit are pure
     # functions of the seed, so exact match is the right band even
@@ -104,6 +113,9 @@ HIGHER_BETTER = (
     "mfu_segmented",
     "mfu_compiled",
     "serve.goodput_tok_s",
+    "serve.prefix.goodput_tok_s",
+    "serve.prefix.goodput_gain",
+    "serve.prefix.shared_page_hits",
     "soak.goodput_tok_s",
     "decode.paged_tok_s",
     "decode.paged_speedup",
@@ -139,6 +151,11 @@ DEFAULT_METRICS = (
     "serve.goodput_tok_s",
     "serve.ttft_p99_ms",
     "serve.queue_wait_p95_ms",
+    "serve.prefix.goodput_tok_s",
+    "serve.prefix.ttft_p99_ms",
+    "serve.prefix.goodput_gain",
+    "serve.prefix.shared_page_hits",
+    "serve.prefix.pages_leaked",
     "decode.paged_tokens_exact",
     "decode.pages_leaked",
     "decode.kernel_tokens_exact",

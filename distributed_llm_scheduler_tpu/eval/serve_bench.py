@@ -60,6 +60,22 @@ SCENARIO = {
     "idle_s": 0.005,
 }
 
+#: the prefix-heavy chat scenario layered on the SCENARIO geometry:
+#: multi-turn sessions share an 8-token (one-page) system prompt and
+#: each turn's prompt extends the previous turn's, so under the same
+#: page contention (12 allocatable pages) the sharing engine admits
+#: earlier — the whole goodput/TTFT win is page-contention relief,
+#: which is exactly what virtual time can measure deterministically
+PREFIX_SCENARIO = {
+    "system_len": 8,
+    "user_len": 8,
+    "turns": 2,
+    "n_sessions": 16,
+    "prefix_rate_rps": 40.0,
+    "prefix_max_new_tokens": (8,),
+    "think_time_s": 0.05,
+}
+
 
 def build_serve_engine(
     slots: int = 4,
@@ -71,13 +87,17 @@ def build_serve_engine(
     flight: Any = None,
     metrics: Any = None,
     attention_impl: Any = None,
+    sharing: bool = False,
 ):
     """One tiny-GPT2 paged engine on the first CPU/TPU device, built
     through ``DeviceBackend.paged_decode_engine`` (pre-execution gate
     included) — the same construction the slo CLI and tests use.
 
     ``attention_impl`` is baked into the DAG's layer tasks (``xla`` /
-    ``pallas`` / ``pallas_interpret`` / ``auto``; None = op auto)."""
+    ``pallas`` / ``pallas_interpret`` / ``auto``; None = op auto).
+    ``sharing`` enables the pool's prefix-chunk intern table (the flag
+    can also be toggled on ``engine.pool.sharing`` between reset legs —
+    how the bench compares the two modes on one warmed engine)."""
     import jax
 
     from ..backends.device import DeviceBackend
@@ -99,7 +119,9 @@ def build_serve_engine(
     }
     cluster = Cluster.from_jax_devices(jax.devices()[:1])
     sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    pool = PagePool(n_pages=n_pages, page_size=page_size)
+    pool = PagePool(
+        n_pages=n_pages, page_size=page_size, sharing=sharing
+    )
     eng = DeviceBackend(cluster).paged_decode_engine(
         dag.graph, sched, cfg, weights, pool,
         slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
@@ -117,13 +139,15 @@ def run_serving_leg(
     time_model: Any,
     scenario: Optional[Dict[str, Any]] = None,
     engine: Any = None,
+    prompt_fn: Any = None,
 ) -> Dict[str, Any]:
     """One frontend run over a clean engine + VirtualClock at t=0;
     returns the frontend report with the run digest attached.
 
     Pass a warmed ``engine`` (built with a VirtualClock) to skip
     recompilation — it is reset, and its clock rewound to 0, so the leg
-    sees exactly the state a fresh build would."""
+    sees exactly the state a fresh build would.  ``prompt_fn`` overrides
+    the frontend's prompt materializer (the shared-prefix legs)."""
     from ..serve.frontend import ServingFrontend, VirtualClock
 
     if engine is None:
@@ -139,6 +163,7 @@ def run_serving_leg(
     fe = ServingFrontend(
         engine, arrivals, policy, admission=admission,
         preemption=preemption, time_model=time_model,
+        prompt_fn=prompt_fn,
     )
     leg = fe.run()
     leg["digest"] = fe.digest()
@@ -148,10 +173,13 @@ def run_serving_leg(
 def measure_serving(seed: int = 7,
                     scenario: Optional[Dict[str, Any]] = None,
                     engine: Optional[Any] = None,
+                    prefix: bool = True,
                     ) -> Dict[str, Any]:
     """The full comparison: fifo admit-all vs slo+preemption on the
     same arrival schedule, plus a same-seed determinism repeat of the
-    slo leg.  Returns the ``dls.serve/1`` artifact dict.
+    slo leg, plus (``prefix=True``) the shared-prefix leg pair from
+    :func:`measure_prefix_sharing`.  Returns the ``dls.serve/1``
+    artifact dict.
 
     ``engine`` (test seam) reuses an already-compiled engine instead of
     building one; the caller must have rebound it to a fresh
@@ -194,7 +222,7 @@ def measure_serving(seed: int = 7,
     repeat = run_serving_leg(arrivals, policy, "slo", True, tm, sc,
                              engine=eng)
     deterministic = slo["digest"] == repeat["digest"]
-    return {
+    art = {
         "schema": SCHEMA,
         "seed": seed,
         "scenario": {
@@ -209,6 +237,7 @@ def measure_serving(seed: int = 7,
         },
         "policy": policy.to_json(),
         "time_model": tm.to_json(),
+        "attention_impl": eng.summary()["attention_impl"],
         "legs": {"fifo_admit_all": fifo, "slo_preempt": slo},
         "deterministic": deterministic,
         "goodput_gain_vs_fifo": (
@@ -220,6 +249,167 @@ def measure_serving(seed: int = 7,
         "serve.goodput_tok_s": slo["goodput_tok_s"],
         "serve.ttft_p99_ms": slo["ttft_p99_ms"],
         "serve.queue_wait_p95_ms": slo["queue_wait_p95_ms"],
+    }
+    if prefix:
+        art["prefix"] = measure_prefix_sharing(
+            seed=seed, scenario=scenario, engine=eng
+        )
+        px = art["prefix"]
+        shared = px["legs"]["shared"]
+        acct = px["accounting"]["shared"]
+        art["serve.prefix.goodput_tok_s"] = shared["goodput_tok_s"]
+        art["serve.prefix.ttft_p99_ms"] = shared["ttft_p99_ms"]
+        art["serve.prefix.goodput_gain"] = px["goodput_gain"]
+        art["serve.prefix.shared_page_hits"] = acct["shared_page_hits"]
+        art["serve.prefix.pages_leaked"] = (
+            shared["pages_leaked"]
+            + px["legs"]["unshared"]["pages_leaked"]
+        )
+    return art
+
+
+def _page_peaks(events: Sequence[Dict[str, Any]]) -> Dict[str, int]:
+    """Replay alloc/free/share/unshare into the logical-vs-physical
+    accounting the prefix gate asserts: peaks, end counts (both must be
+    zero on a clean drain), and the number of aliasing hits."""
+    phys = logical = ppeak = lpeak = hits = 0
+    for e in events:
+        k, n = e["kind"], len(e["pages"])
+        if k == "alloc":
+            phys += n
+            logical += n
+        elif k == "free":
+            phys -= n
+            logical -= n
+        elif k == "share":
+            logical += n
+            hits += n
+        elif k == "unshare":
+            logical -= n
+        ppeak = max(ppeak, phys)
+        lpeak = max(lpeak, logical)
+    return {
+        "physical_pages_peak": ppeak,
+        "logical_pages_peak": lpeak,
+        "physical_pages_end": phys,
+        "logical_pages_end": logical,
+        "shared_page_hits": hits,
+    }
+
+
+def measure_prefix_sharing(
+    seed: int = 7,
+    scenario: Optional[Dict[str, Any]] = None,
+    engine: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """The prefix-heavy comparison: the SAME multi-turn session schedule
+    served with prefix sharing on vs off, on one warmed engine (the
+    pool's ``sharing`` flag is toggled between reset legs, and restored
+    — with a final reset — before returning, so a session-shared engine
+    leaves exactly as it arrived).
+
+    Every leg runs with an ownership log attached; the log is replayed
+    through the page-lifetime prover (zero findings required) and
+    folded into the logical-vs-physical accounting block the gates
+    check.  A same-seed repeat of the shared leg must digest
+    identically."""
+    import functools
+
+    from ..analysis.page_pass import analyze_pages
+    from ..models.kv_pages import PageOwnershipLog
+    from ..obs.slo import SLOPolicy
+    from ..serve.frontend import ServiceTimeModel, VirtualClock
+    from ..serve.loadgen import (
+        schedule_digest,
+        session_arrivals,
+        session_prompt_token_ids,
+    )
+
+    sc = dict(SCENARIO, **PREFIX_SCENARIO, **(scenario or {}))
+    arrivals = session_arrivals(
+        sc["prefix_rate_rps"], sc["n_sessions"], seed,
+        system_len=sc["system_len"], user_len=sc["user_len"],
+        turns=sc["turns"],
+        max_new_tokens=sc["prefix_max_new_tokens"],
+        priorities=sc["priorities"],
+        priority_weights=sc["priority_weights"],
+        think_time_s=sc["think_time_s"],
+    )
+    prompt_fn = functools.partial(
+        session_prompt_token_ids,
+        system_len=sc["system_len"], user_len=sc["user_len"],
+    )
+    policy = SLOPolicy(
+        ttft_s=sc["ttft_s"], window_s=sc["window_s"],
+        percentile=sc["percentile"],
+    )
+    tm = ServiceTimeModel(
+        wave_s=sc["wave_s"], segment_s=sc["segment_s"],
+        idle_s=sc["idle_s"],
+    )
+    if engine is not None:
+        eng = engine
+    else:
+        eng, _pool = build_serve_engine(
+            slots=sc["slots"], page_size=sc["page_size"],
+            n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
+            seg_steps=sc["seg_steps"], clock=VirtualClock(),
+        )
+    prev_sharing = bool(getattr(eng.pool, "sharing", False))
+    legs: Dict[str, Dict[str, Any]] = {}
+    logs: Dict[str, PageOwnershipLog] = {}
+    try:
+        for name, mode in (("unshared", False), ("shared", True),
+                           ("repeat", True)):
+            eng.pool.sharing = mode
+            log = PageOwnershipLog()
+            eng.attach_ownership_log(log)
+            legs[name] = run_serving_leg(
+                arrivals, policy, "slo", True, tm, sc,
+                engine=eng, prompt_fn=prompt_fn,
+            )
+            logs[name] = log
+    finally:
+        eng.attach_ownership_log(None)
+        eng.pool.sharing = prev_sharing
+        eng.reset()
+    accounting = {
+        name: _page_peaks(logs[name].events)
+        for name in ("shared", "unshared")
+    }
+    page_pass = {
+        name: [d.code for d in analyze_pages(logs[name]).diagnostics]
+        for name in ("shared", "unshared")
+    }
+    cow_splits = sum(
+        1 for e in logs["shared"].events if e["kind"] == "cow"
+    )
+    unshared_gp = legs["unshared"]["goodput_tok_s"]
+    return {
+        "scenario": {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in sc.items()
+        },
+        "offered_load": {
+            "rate_rps": sc["prefix_rate_rps"],
+            "n_requests": len(arrivals),
+            "n_sessions": sc["n_sessions"],
+            "arrival_span_s": arrivals[-1].t,
+            "schedule_digest": schedule_digest(arrivals),
+        },
+        "legs": {
+            "shared": legs["shared"], "unshared": legs["unshared"],
+        },
+        "deterministic": (
+            legs["shared"]["digest"] == legs["repeat"]["digest"]
+        ),
+        "accounting": accounting,
+        "page_pass": page_pass,
+        "cow_splits": cow_splits,
+        "goodput_gain": (
+            legs["shared"]["goodput_tok_s"] / unshared_gp
+            if unshared_gp else None
+        ),
     }
 
 
@@ -239,6 +429,66 @@ def gate_failures(art: Dict[str, Any]) -> List[str]:
         failures.append(f"{art['pages_leaked']} pages leaked")
     if not art["deterministic"]:
         failures.append("same-seed repeat diverged (digest mismatch)")
+    if "prefix" in art:
+        failures.extend(prefix_gate_failures(art["prefix"]))
+    return failures
+
+
+def prefix_gate_failures(px: Dict[str, Any]) -> List[str]:
+    """The r17 shared-prefix gates: sharing must strictly beat the
+    sharing-disabled leg on BOTH goodput and TTFT p99 at equal offered
+    load, actually alias pages, keep the refcount books exact (logical
+    >= physical, both legs drain to zero physical pages, the
+    page-lifetime prover finds nothing), and repeat digest-identically."""
+    failures: List[str] = []
+    shared = px["legs"]["shared"]
+    unshared = px["legs"]["unshared"]
+    if not shared["goodput_tok_s"] > unshared["goodput_tok_s"]:
+        failures.append(
+            f"prefix sharing goodput {shared['goodput_tok_s']:.1f} tok/s "
+            f"not strictly above sharing-disabled "
+            f"{unshared['goodput_tok_s']:.1f} tok/s"
+        )
+    if not shared["ttft_p99_ms"] < unshared["ttft_p99_ms"]:
+        failures.append(
+            f"prefix sharing ttft p99 {shared['ttft_p99_ms']:.1f} ms not "
+            f"strictly below sharing-disabled "
+            f"{unshared['ttft_p99_ms']:.1f} ms"
+        )
+    if shared["completed"] < 1 or unshared["completed"] < 1:
+        failures.append("a prefix leg completed zero requests")
+    for name in ("shared", "unshared"):
+        if px["legs"][name]["pages_leaked"]:
+            failures.append(
+                f"prefix {name} leg leaked "
+                f"{px['legs'][name]['pages_leaked']} pages"
+            )
+        acct = px["accounting"][name]
+        if acct["physical_pages_end"] or acct["logical_pages_end"]:
+            failures.append(
+                f"prefix {name} leg accounting did not drain to zero "
+                f"(physical {acct['physical_pages_end']}, logical "
+                f"{acct['logical_pages_end']})"
+            )
+        if acct["logical_pages_peak"] < acct["physical_pages_peak"]:
+            failures.append(
+                f"prefix {name} leg logical peak "
+                f"{acct['logical_pages_peak']} below physical peak "
+                f"{acct['physical_pages_peak']}"
+            )
+        if px["page_pass"][name]:
+            failures.append(
+                f"prefix {name} leg page pass found "
+                f"{px['page_pass'][name]}"
+            )
+    if px["accounting"]["shared"]["shared_page_hits"] < 1:
+        failures.append("prefix shared leg never aliased a page")
+    if px["accounting"]["unshared"]["shared_page_hits"]:
+        failures.append("sharing-disabled leg recorded share events")
+    if not px["deterministic"]:
+        failures.append(
+            "prefix shared same-seed repeat diverged (digest mismatch)"
+        )
     return failures
 
 
@@ -252,8 +502,19 @@ _LEG_REQUIRED = (
 )
 _TOP_REQUIRED = (
     "schema", "seed", "scenario", "offered_load", "policy", "time_model",
-    "legs", "deterministic", "pages_leaked", "serve.goodput_tok_s",
-    "serve.ttft_p99_ms", "serve.queue_wait_p95_ms",
+    "attention_impl", "legs", "deterministic", "pages_leaked",
+    "serve.goodput_tok_s", "serve.ttft_p99_ms", "serve.queue_wait_p95_ms",
+)
+#: required inside the (optional) top-level ``prefix`` block; when the
+#: block is present the four flattened ``serve.prefix.*`` regression
+#: metrics must be present too
+_PREFIX_REQUIRED = (
+    "scenario", "offered_load", "legs", "deterministic", "accounting",
+    "page_pass", "cow_splits", "goodput_gain",
+)
+_PREFIX_ACCT_REQUIRED = (
+    "physical_pages_peak", "logical_pages_peak", "physical_pages_end",
+    "logical_pages_end", "shared_page_hits",
 )
 
 
@@ -297,6 +558,47 @@ def validate_serve_artifact(art: Any) -> List[str]:
         v = art.get(f)
         if f in art and not isinstance(v, (int, float)):
             errs.append(f"{f} is not numeric")
+    if "prefix" in art:
+        px = art["prefix"]
+        if not isinstance(px, dict):
+            return errs + ["prefix block is not a dict"]
+        for f in _PREFIX_REQUIRED:
+            if f not in px:
+                errs.append(f"prefix missing {f!r}")
+        plegs = px.get("legs")
+        if isinstance(plegs, dict):
+            for name in ("shared", "unshared"):
+                leg = plegs.get(name)
+                if not isinstance(leg, dict):
+                    errs.append(f"prefix.legs.{name} missing or not a dict")
+                    continue
+                for f in _LEG_REQUIRED:
+                    if f not in leg:
+                        errs.append(f"prefix.legs.{name} missing {f!r}")
+        else:
+            errs.append("prefix.legs block missing or not a dict")
+        acct = px.get("accounting")
+        if isinstance(acct, dict):
+            for name in ("shared", "unshared"):
+                block = acct.get(name)
+                if not isinstance(block, dict):
+                    errs.append(
+                        f"prefix.accounting.{name} missing or not a dict"
+                    )
+                    continue
+                for f in _PREFIX_ACCT_REQUIRED:
+                    if f not in block:
+                        errs.append(f"prefix.accounting.{name} missing {f!r}")
+        else:
+            errs.append("prefix.accounting block missing or not a dict")
+        for f in ("serve.prefix.goodput_tok_s", "serve.prefix.ttft_p99_ms",
+                  "serve.prefix.goodput_gain",
+                  "serve.prefix.shared_page_hits",
+                  "serve.prefix.pages_leaked"):
+            if f not in art:
+                errs.append(f"missing top-level field {f!r}")
+            elif not isinstance(art[f], (int, float)):
+                errs.append(f"{f} is not numeric")
     return errs
 
 
@@ -316,6 +618,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="override request count")
     ap.add_argument("--out", default=None,
                     help="also write the dls.serve/1 artifact here")
+    ap.add_argument("--no-prefix", action="store_true",
+                    help="skip the shared-prefix leg pair")
     args = ap.parse_args(argv)
 
     overrides: Dict[str, Any] = {}
@@ -323,15 +627,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         overrides["rate_rps"] = args.rate
     if args.n_requests is not None:
         overrides["n_requests"] = args.n_requests
-    art = measure_serving(seed=args.seed, scenario=overrides or None)
-    print(json.dumps(
-        {k: v for k, v in art.items() if k != "legs"}
-        | {"legs": {
+    art = measure_serving(seed=args.seed, scenario=overrides or None,
+                          prefix=not args.no_prefix)
+
+    def _strip(legs: Dict[str, Any]) -> Dict[str, Any]:
+        return {
             name: {k: v for k, v in leg.items() if k != "requests"}
-            for name, leg in art["legs"].items()
-        }},
-        indent=1, sort_keys=True,
-    ))
+            for name, leg in legs.items()
+        }
+
+    shown = {k: v for k, v in art.items() if k not in ("legs", "prefix")}
+    shown["legs"] = _strip(art["legs"])
+    if "prefix" in art:
+        shown["prefix"] = (
+            {k: v for k, v in art["prefix"].items() if k != "legs"}
+            | {"legs": _strip(art["prefix"]["legs"])}
+        )
+    print(json.dumps(shown, indent=1, sort_keys=True))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(art, f, indent=1, sort_keys=True)
@@ -350,6 +662,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         "0 pages leaked, deterministic",
         file=sys.stderr,
     )
+    if "prefix" in art:
+        px = art["prefix"]
+        sh = px["legs"]["shared"]
+        un = px["legs"]["unshared"]
+        print(
+            f"PREFIX GATES PASS: {sh['goodput_tok_s']:.0f} tok/s / "
+            f"{sh['ttft_p99_ms']:.0f} ms ttft p99 (sharing) vs "
+            f"{un['goodput_tok_s']:.0f} tok/s / {un['ttft_p99_ms']:.0f} ms "
+            f"(disabled), {px['accounting']['shared']['shared_page_hits']} "
+            f"pages aliased, {px['cow_splits']} cow splits, page pass "
+            "clean, 0 pages leaked, deterministic",
+            file=sys.stderr,
+        )
     return 0
 
 

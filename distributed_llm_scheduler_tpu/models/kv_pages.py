@@ -34,8 +34,9 @@ unused tail read finite (masked-out) garbage instead of faulting.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,11 +58,20 @@ class PageOwnershipLog:
     """Append-only page ownership event stream — the static third leg of
     the page-accounting story next to the runtime ``pages_leaked`` gauge.
 
-    Producers record four event kinds: ``alloc``/``free`` (the
+    Producers record four core event kinds: ``alloc``/``free`` (the
     :class:`PagePool` itself, with the pool's free/used counts after the
     event — the tiling witness) and ``assign``/``release`` (the decode
     engine, with the owning request id and the lifecycle edge —
-    ``admit``/``retire``/``preempt``/``reset``).  The page-lifetime
+    ``admit``/``retire``/``preempt``/``reset``).  Prefix sharing adds
+    four more: ``share``/``unshare`` (the pool, refcount up/down without
+    touching the free list — physical tiling counts ride along
+    unchanged), ``cow`` (the engine: ``pages=[src, dst]`` of a
+    copy-on-write split, dst allocated BEFORE src is released), and
+    ``write`` (the engine: first generation write into a page — the
+    witness PGL007 checks against live refcounts).  Ref-counted events
+    carry a ``refcounts`` list (post-event, aligned with ``pages``);
+    non-sharing producers omit the key entirely so disabled-sharing
+    streams are byte-identical to pre-sharing ones.  The page-lifetime
     prover (:mod:`..analysis.page_pass`) replays the stream against an
     ownership lattice; recording is a dict append per pool operation and
     is completely off (zero overhead, bit-identical engine behavior)
@@ -82,8 +92,9 @@ class PageOwnershipLog:
         site: Optional[str] = None,
         free_pages: Optional[int] = None,
         used_pages: Optional[int] = None,
+        refcounts: Optional[Sequence[int]] = None,
     ) -> None:
-        self.events.append({
+        e: Dict[str, Any] = {
             "seq": len(self.events),
             "kind": kind,
             "pages": [int(p) for p in pages],
@@ -91,7 +102,10 @@ class PageOwnershipLog:
             "site": site,
             "free_pages": free_pages,
             "used_pages": used_pages,
-        })
+        }
+        if refcounts is not None:
+            e["refcounts"] = [int(r) for r in refcounts]
+        self.events.append(e)
 
     def __len__(self) -> int:
         return len(self.events)
@@ -113,6 +127,38 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
     return -(-n_tokens // page_size)
 
 
+def prefix_chunk_keys(tokens: Any, page_size: int) -> List[str]:
+    """Chain-hash intern keys for every FULL page of a token prefix.
+
+    Key ``i`` digests the entire prefix ``tokens[0:(i+1)*page_size]``,
+    not just page ``i``'s own tokens — a KV row depends on every token
+    before it, so two pages are interchangeable only when their whole
+    prefixes match.  Chaining gives that for free: each key extends the
+    previous digest, so a match on key ``i`` implies matches on all
+    earlier keys.  Only full pages get keys (a partial tail page is
+    always exclusive — generation writes into it).
+    """
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    toks = _flatten_tokens(tokens)
+    h = hashlib.sha256()
+    keys: List[str] = []
+    for i in range(len(toks) // page_size):
+        chunk = toks[i * page_size:(i + 1) * page_size]
+        h.update((",".join(map(str, chunk)) + ";").encode())
+        keys.append(h.hexdigest())
+    return keys
+
+
+def _flatten_tokens(tokens: Any) -> List[int]:
+    """Host-side flatten of a token container (list, numpy row, or jax
+    row) into plain ints — hashing never traces."""
+    if hasattr(tokens, "reshape"):
+        flat = tokens.reshape(-1)
+        return [int(t) for t in flat.tolist()]
+    return [int(t) for t in tokens]
+
+
 def pool_bytes_per_layer(
     n_pages: int, page_size: int, n_kv_heads: int, head_dim: int, dtype: Any
 ) -> int:
@@ -130,6 +176,18 @@ class PagePool:
     raises so callers (the continuous-batching engine) can hold requests
     queued instead of silently corrupting the pool — backpressure, not
     clamping.
+
+    With ``sharing=True`` the pool additionally interns full prefix
+    chunks (:func:`prefix_chunk_keys`): a resident page whose chain hash
+    matches a new request's prefix is aliased via :meth:`share` instead
+    of re-allocated, reference counts track logical owners per physical
+    page, and :meth:`release_ref` returns a page to the LIFO free list
+    only on last release.  The tiling witness generalizes — ``free +
+    unique_used == n_pages - 1`` holds over *physical* pages at every
+    event, while :attr:`logical_pages` counts what a non-sharing pool
+    would have had to allocate.  With sharing off (the default) every
+    page has refcount 1 and alloc/free behave — and record —
+    bit-identically to the pre-sharing pool.
     """
 
     n_pages: int
@@ -140,6 +198,11 @@ class PagePool:
     #: event carrying the post-event free/used counts (the tiling
     #: witness).  None — the default — records nothing and costs nothing.
     ownlog: Optional[Any] = field(default=None, repr=False, compare=False)
+    #: enable content-addressed prefix sharing (intern table + refcounts)
+    sharing: bool = False
+    _refs: Dict[int, int] = field(default_factory=dict, repr=False)
+    _intern: Dict[str, int] = field(default_factory=dict, repr=False)
+    _page_key: Dict[int, str] = field(default_factory=dict, repr=False)
 
     def __post_init__(self) -> None:
         if self.n_pages < 2:
@@ -188,7 +251,22 @@ class PagePool:
 
     @property
     def used_pages(self) -> int:
+        """Physical pages allocated (unique — aliases count once)."""
         return len(self._allocated)
+
+    @property
+    def logical_pages(self) -> int:
+        """Sum of refcounts: what a sharing-oblivious pool would hold.
+        Equals :attr:`used_pages` whenever nothing is shared."""
+        return sum(self._refs.values())
+
+    @property
+    def shared_pages(self) -> int:
+        """Physical pages with more than one live reference."""
+        return sum(1 for rc in self._refs.values() if rc > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
@@ -206,6 +284,8 @@ class PagePool:
             )
         pages = [self._free.pop() for _ in range(n)]
         self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         if self.ownlog is not None:
             self.ownlog.record(
                 "alloc", pages,
@@ -219,20 +299,105 @@ class PagePool:
     def free(self, pages: Sequence[int]) -> None:
         """Return pages to the free list; double-free and trash-page
         frees are hard errors (a silent one would hand the same page to
-        two sequences)."""
+        two sequences), and so is freeing a page other references still
+        alias (callers drop refs via :meth:`release_ref`)."""
         pages = list(pages)
         for p in pages:
             if p == TRASH_PAGE:
                 raise ValueError("page 0 is reserved and never allocated")
             if p not in self._allocated:
                 raise ValueError(f"double free of page {p}")
+            if self._refs.get(p, 1) > 1:
+                raise ValueError(
+                    f"page {p} is shared (refcount "
+                    f"{self._refs[p]}); release the reference instead"
+                )
             self._allocated.discard(p)
             self._free.append(p)
+            self._refs.pop(p, None)
+            key = self._page_key.pop(p, None)
+            if key is not None and self._intern.get(key) == p:
+                del self._intern[key]
         if self.ownlog is not None:
             self.ownlog.record(
                 "free", pages,
                 free_pages=len(self._free), used_pages=len(self._allocated),
             )
+
+    # -- prefix sharing ----------------------------------------------------
+    def match_prefix(self, keys: Sequence[str]) -> Tuple[int, List[int]]:
+        """Longest resident run of ``keys`` (chain hashes, in prefix
+        order): returns ``(h, pages)`` where the first ``h`` keys are
+        interned and ``pages`` are their physical ids.  Pure lookup — no
+        refcounts move until the caller commits with :meth:`share`."""
+        if not self.sharing:
+            return 0, []
+        pages: List[int] = []
+        for k in keys:
+            p = self._intern.get(k)
+            if p is None:
+                break
+            pages.append(p)
+        return len(pages), pages
+
+    def share(self, pages: Sequence[int]) -> None:
+        """Take one additional reference on each page (aliasing commit).
+        Free/used counts are untouched — the ``share`` event carries
+        them so the prover's physical tiling witness extends across
+        sharing traffic."""
+        if not self.sharing:
+            raise ValueError("share() on a pool with sharing disabled")
+        pages = list(pages)
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(f"share of unallocated page {p}")
+            self._refs[p] = self._refs.get(p, 0) + 1
+        if self.ownlog is not None:
+            self.ownlog.record(
+                "share", pages,
+                free_pages=len(self._free), used_pages=len(self._allocated),
+                refcounts=[self._refs[p] for p in pages],
+            )
+
+    def register(self, page: int, key: str) -> None:
+        """Intern ``page`` under chain-hash ``key`` (first writer wins —
+        a duplicate key keeps the incumbent so its aliases stay valid).
+        No-op with sharing disabled."""
+        if not self.sharing:
+            return
+        page = int(page)
+        if page not in self._allocated:
+            raise ValueError(f"register of unallocated page {page}")
+        if key in self._intern or page in self._page_key:
+            return
+        self._intern[key] = page
+        self._page_key[page] = key
+
+    def release_ref(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page: last release frees physically
+        (normal ``free`` event, page returns to the LIFO free list and
+        its intern entry is evicted); earlier releases only decrement
+        and record ``unshare``."""
+        to_free: List[int] = []
+        unshared: List[int] = []
+        for p in pages:
+            p = int(p)
+            if p not in self._allocated:
+                raise ValueError(f"release_ref of unallocated page {p}")
+            rc = self._refs.get(p, 1)
+            if rc <= 1:
+                to_free.append(p)
+            else:
+                self._refs[p] = rc - 1
+                unshared.append(p)
+        if unshared and self.ownlog is not None:
+            self.ownlog.record(
+                "unshare", unshared,
+                free_pages=len(self._free), used_pages=len(self._allocated),
+                refcounts=[self._refs[p] for p in unshared],
+            )
+        if to_free:
+            self.free(to_free)
 
 
 def init_paged_kv(
@@ -395,6 +560,7 @@ __all__ = [
     "PageOwnershipLog",
     "PagePool",
     "pages_needed",
+    "prefix_chunk_keys",
     "pool_bytes_per_layer",
     "init_paged_kv",
     "page_table_array",

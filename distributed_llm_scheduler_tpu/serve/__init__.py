@@ -9,6 +9,7 @@ from .soak import (
     SoakConfig,
     inject_jit_churn,
     inject_page_leak,
+    inject_refcount_underflow,
     load_soak_artifact,
     run_soak,
     validate_soak_artifact,
@@ -22,6 +23,8 @@ from .loadgen import (
     prompt_token_ids,
     save_trace,
     schedule_digest,
+    session_arrivals,
+    session_prompt_token_ids,
     validate_trace_obj,
 )
 
@@ -37,9 +40,12 @@ __all__ = [
     "prompt_token_ids",
     "save_trace",
     "schedule_digest",
+    "session_arrivals",
+    "session_prompt_token_ids",
     "SoakConfig",
     "inject_jit_churn",
     "inject_page_leak",
+    "inject_refcount_underflow",
     "load_soak_artifact",
     "run_soak",
     "validate_soak_artifact",

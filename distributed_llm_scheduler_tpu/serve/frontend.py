@@ -155,6 +155,7 @@ class ServingFrontend:
         prompt_seed: int = 0,
         max_ticks: int = 100_000,
         sleep: Optional[Any] = None,
+        prompt_fn: Optional[Any] = None,
     ):
         if admission not in ("fifo", "slo"):
             raise ValueError(
@@ -180,6 +181,13 @@ class ServingFrontend:
         # fake clock + recording sleep to cover the wall-clock path
         # without spending wall time
         self._sleep = sleep if sleep is not None else time.sleep
+        # pluggable prompt materializer (rid, prompt_len, vocab, seed) ->
+        # (1, P) int32 — how the shared-prefix workload derives session
+        # prompts; the default is the pre-existing per-rid generator, so
+        # existing callers are bit-identical
+        self.prompt_fn = (
+            prompt_fn if prompt_fn is not None else prompt_token_ids
+        )
         self.prompt_seed = prompt_seed
         self.max_ticks = max_ticks
         self.vocab_size = int(getattr(engine.config, "vocab_size", 256))
@@ -262,7 +270,7 @@ class ServingFrontend:
         # 1. inject arrivals whose deadline has passed
         while self._pending and self._pending[0].t <= rel + 1e-9:
             a = self._pending.pop(0)
-            req = _Req(a, prompt_token_ids(
+            req = _Req(a, self.prompt_fn(
                 a.rid, a.prompt_len, self.vocab_size, self.prompt_seed
             ))
             self._reqs[a.rid] = req
@@ -354,10 +362,19 @@ class ServingFrontend:
         )
         submitted: List[_Req] = []
         lens = set()
+        sharing = bool(getattr(self.engine, "sharing", False))
         for req in order:
             if breaching and req.a.priority > 0 and not req.passes:
                 continue  # defer low tier while the TTFT window breaches
-            need = pages_needed(req.total_rows, self.engine.page_size)
+            if sharing:
+                # fresh-tail footprint only: resident shared prefix
+                # chunks cost no new pages, so admission sees the same
+                # headroom the engine's allocator will
+                need = self.engine.fresh_pages_needed(
+                    req.cur_prompt, req.cur_max_new
+                )
+            else:
+                need = pages_needed(req.total_rows, self.engine.page_size)
             if free_slots < 1 or need > free_pages:
                 if not (self.preemption and req.a.priority == 0):
                     continue
@@ -380,7 +397,11 @@ class ServingFrontend:
         """Evict lower-tier in-flight victims until ``req`` fits;
         returns the new (free_slots, free_pages) or None when no victim
         set suffices (then nothing is evicted)."""
-        per_req = self.engine.page_occupancy()["per_request"]
+        occ = self.engine.page_occupancy()
+        # under sharing, evicting a victim frees only its EXCLUSIVE
+        # pages (aliased prefix chunks stay resident for their other
+        # owners) — the conservative count keeps the estimate honest
+        per_req = occ.get("per_request_exclusive", occ["per_request"])
         victims = [
             v for v in self._inflight.values()
             if v.a.priority > req.a.priority and v.passes

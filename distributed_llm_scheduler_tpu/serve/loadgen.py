@@ -103,6 +103,114 @@ def poisson_arrivals(
     return out
 
 
+def session_arrivals(
+    rate_rps: float,
+    n_sessions: int,
+    seed: int,
+    *,
+    system_len: int,
+    user_len: int,
+    turns: int = 2,
+    max_new_tokens: Sequence[int] = (8,),
+    priorities: Sequence[int] = (0,),
+    priority_weights: Optional[Sequence[float]] = None,
+    think_time_s: float = 0.05,
+    rid_prefix: str = "s",
+) -> List[Arrival]:
+    """Deterministic shared-prefix / multi-turn session schedule.
+
+    Session STARTS are a seeded Poisson process at ``rate_rps``; each
+    session then resubmits ``turns`` times with exponential think-time
+    gaps, every turn growing the prompt by ``user_len`` tokens on top of
+    the shared ``system_len``-token system prompt (turn ``k`` arrives
+    with ``prompt_len = system_len + (k+1) * user_len``).  Rids are
+    derived — ``{prefix}{i}t{k}`` — so :func:`session_prompt_token_ids`
+    can reconstruct each turn's prompt as the EXACT extension of the
+    previous turn's (and of every other session's system prompt), which
+    is what makes the workload prefix-shareable.  Plain
+    :class:`Arrival` rows: the same ``dls.arrivals/1`` trace round-trip,
+    digest, and replay machinery applies unchanged.
+    """
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if n_sessions < 1:
+        raise ValueError(f"n_sessions must be >= 1, got {n_sessions}")
+    if turns < 1:
+        raise ValueError(f"turns must be >= 1, got {turns}")
+    if system_len < 1 or user_len < 1:
+        raise ValueError(
+            f"system_len/user_len must be >= 1, got "
+            f"{system_len}/{user_len}"
+        )
+    rng = np.random.RandomState(seed)
+    p = None
+    if priority_weights is not None:
+        if len(priority_weights) != len(priorities):
+            raise ValueError(
+                f"{len(priority_weights)} weights for "
+                f"{len(priorities)} priorities"
+            )
+        total = float(sum(priority_weights))
+        p = [w / total for w in priority_weights]
+    out: List[Arrival] = []
+    t = 0.0
+    for i in range(n_sessions):
+        t += float(rng.exponential(1.0 / rate_rps))
+        prio = int(rng.choice(list(priorities), p=p))
+        tk = t
+        for k in range(turns):
+            if k > 0:
+                tk += float(rng.exponential(think_time_s))
+            out.append(Arrival(
+                rid=f"{rid_prefix}{i}t{k}",
+                t=tk,
+                prompt_len=system_len + (k + 1) * user_len,
+                max_new_tokens=int(rng.choice(list(max_new_tokens))),
+                priority=prio,
+            ))
+    out.sort(key=lambda a: (a.t, a.rid))
+    return out
+
+
+def session_prompt_token_ids(
+    rid: Any,
+    prompt_len: int,
+    vocab_size: int,
+    seed: int = 0,
+    *,
+    system_len: int,
+    user_len: int,
+) -> np.ndarray:
+    """Prompt materializer for :func:`session_arrivals` rids: the shared
+    system chunk, then one derived user chunk per turn — so turn ``k``'s
+    prompt is bitwise turn ``k-1``'s plus one more chunk, and EVERY
+    session starts with the identical ``system_len`` tokens.
+
+    ``rid`` must be ``{session}t{k}``; the chunks are derived through
+    :func:`prompt_token_ids` under synthetic rids (``__system__`` and
+    ``{session}u{j}``), so determinism and trace-free replay carry over.
+    """
+    srid = str(rid)
+    sid, _, turn = srid.rpartition("t")
+    if not sid or not turn.isdigit():
+        raise ValueError(
+            f"session rid must look like '<session>t<turn>', got {srid!r}"
+        )
+    k = int(turn)
+    want = system_len + (k + 1) * user_len
+    if prompt_len != want:
+        raise ValueError(
+            f"rid {srid!r} turn {k} implies prompt_len {want}, "
+            f"got {prompt_len}"
+        )
+    parts = [prompt_token_ids("__system__", system_len, vocab_size, seed)]
+    for j in range(k + 1):
+        parts.append(
+            prompt_token_ids(f"{sid}u{j}", user_len, vocab_size, seed)
+        )
+    return np.concatenate(parts, axis=1)
+
+
 def prompt_token_ids(
     rid: Any, prompt_len: int, vocab_size: int, seed: int = 0
 ) -> np.ndarray:
@@ -211,5 +319,7 @@ __all__ = [
     "prompt_token_ids",
     "save_trace",
     "schedule_digest",
+    "session_arrivals",
+    "session_prompt_token_ids",
     "validate_trace_obj",
 ]

@@ -140,6 +140,49 @@ def inject_page_leak(engine: Any, every: int = 2) -> Any:
     return leaky
 
 
+class _UnderflowPool:
+    """Delegating pool wrapper that loses the reference taken by the
+    first ``share`` — the classic refcount-underflow bug: the alias is
+    handed out but never counted, so the LAST release frees a page
+    other requests still read.  A buggy pool would also swallow the
+    resulting release-of-freed-page errors, so the wrapper does too,
+    page by page (otherwise the run crashes instead of being
+    convicted)."""
+
+    def __init__(self, pool: Any):
+        self._inner = pool
+        self.dropped: List[int] = []
+
+    def share(self, pages: Any) -> None:
+        pages = list(pages)
+        self._inner.share(pages)
+        if pages and not self.dropped:
+            p = int(pages[0])
+            self._inner._refs[p] -= 1
+            self.dropped.append(p)
+
+    def release_ref(self, pages: Any) -> None:
+        for p in pages:
+            try:
+                self._inner.release_ref([p])
+            except ValueError:
+                pass
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+def inject_refcount_underflow(engine: Any) -> Any:
+    """Swap the engine's pool for an :class:`_UnderflowPool`; returns
+    the wrapper for inspection.  The page-lifetime prover convicts the
+    bug statically: carried refcount witnesses disagree with the
+    replayed counts and the premature free lands on a page with live
+    references — PGL006 (plus PGL003 for the still-live owner)."""
+    pool = _UnderflowPool(engine.pool)
+    engine.pool = pool
+    return pool
+
+
 def inject_jit_churn(engine: Any) -> None:
     """Plant one fresh synthetic compile-class key per segment: the
     prefill cache grows exactly as if every wave hit a new (P, b)
@@ -289,6 +332,7 @@ def run_soak(
             "n_requests": len(arrivals),
             "schedule_digest": schedule_digest(arrivals),
         },
+        "attention_impl": eng.summary()["attention_impl"],
         "serving": serving,
         "digest": fe.digest(),
         "flight_dumps": list(flight.dumps) if flight else [],
@@ -339,8 +383,8 @@ def _steady_state(store: Any, warmup_s: float) -> Dict[str, Any]:
 # -- artifact schema -------------------------------------------------------
 _TOP_REQUIRED = (
     "schema", "seed", "config", "clock", "injection", "offered_load",
-    "serving", "digest", "timeseries", "health", "steady_state",
-    "verdict", "soak.goodput_tok_s",
+    "attention_impl", "serving", "digest", "timeseries", "health",
+    "steady_state", "verdict", "soak.goodput_tok_s",
 )
 
 
@@ -408,6 +452,7 @@ __all__ = [
     "SoakConfig",
     "inject_jit_churn",
     "inject_page_leak",
+    "inject_refcount_underflow",
     "load_soak_artifact",
     "run_soak",
     "validate_soak_artifact",

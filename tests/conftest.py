@@ -70,6 +70,44 @@ def session_serve_engine():
 
 
 @pytest.fixture(scope="session")
+def session_slo_engine():
+    """ONE compiled tiny-geometry engine for the SLO/flight-recorder
+    tests (slots=2, page_size=8, n_pages=32, pages_per_seq=4,
+    seg_steps=4 — deliberately different from the bench SCENARIO).
+
+    Tests re-point it at their own clock/tracer/metrics/flight via
+    ``PagedDecodeEngine.rebind_obs``, which also swaps in a pristine
+    ``PagePool`` of the same geometry — so read page accounting off
+    ``eng.pool`` *after* the rebind, not from a captured pool."""
+    from distributed_llm_scheduler_tpu import get_scheduler
+    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+    from distributed_llm_scheduler_tpu.models.kv_pages import PagePool
+
+    cfg = gpt2.GPT2Config.tiny()
+    slots, ps, n_pages, ppseq = 2, 8, 32, 4
+    dag = build_paged_decode_dag(
+        cfg, slots=slots, page_size=ps, n_pages=n_pages, pages_per_seq=ppseq
+    )
+    params = dag.init_params()
+    weights = {
+        k: v for k, v in params.items()
+        if not (k.startswith("cache_") or k == "page_table")
+    }
+    cluster = Cluster.from_jax_devices(jax.devices()[:1])
+    backend = DeviceBackend(cluster)
+    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
+    pool = PagePool(n_pages=n_pages, page_size=ps)
+    return backend.paged_decode_engine(
+        dag.graph, sched, cfg, weights, pool,
+        slots=slots, pages_per_seq=ppseq, seg_steps=4,
+    )
+
+
+@pytest.fixture(scope="session")
 def serve_engine_factory(session_serve_engine):
     """``run_soak(engine_factory=...)``-shaped seam over the session
     engine: rebinds obs per leg; a non-default attention impl changes

@@ -19,6 +19,7 @@ from distributed_llm_scheduler_tpu import Cluster, DeviceState, get_scheduler
 from distributed_llm_scheduler_tpu.models.kv_pages import (
     DEFAULT_PAGE_SIZE,
     TRASH_PAGE,
+    PageOwnershipLog,
     PagePool,
     gather_kv,
     gather_kv_flat,
@@ -26,6 +27,7 @@ from distributed_llm_scheduler_tpu.models.kv_pages import (
     page_table_array,
     pages_needed,
     pool_bytes_per_layer,
+    prefix_chunk_keys,
     write_prompt_kv,
     write_token_kv,
 )
@@ -92,6 +94,101 @@ def test_device_hbm_bytes_is_positive():
 
     assert device_hbm_bytes(jax.devices()[0]) > 0
     assert device_hbm_bytes(None) > 0
+
+
+# -- prefix sharing: intern table, refcounts, chain hashes ------------------
+
+def test_prefix_chunk_keys_chain_over_full_prefix():
+    ks = prefix_chunk_keys(list(range(16)), 4)
+    assert len(ks) == 4  # only FULL pages get keys
+    assert prefix_chunk_keys(list(range(15)), 4) == ks[:3]  # tail dropped
+    # chained: same page 0, divergent page 1 -> key 0 equal, key 1 differs
+    a = prefix_chunk_keys([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    b = prefix_chunk_keys([1, 2, 3, 4, 9, 9, 9, 9], 4)
+    assert a[0] == b[0] and a[1] != b[1]
+    # a page-0 divergence poisons every later key (whole-prefix digest,
+    # not per-page: KV rows depend on everything before them)
+    c = prefix_chunk_keys([9, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert c[0] != a[0] and c[1] != a[1]
+    # container-agnostic: a (1, P) device row hashes like a plain list
+    assert (prefix_chunk_keys(jnp.asarray([[1, 2, 3, 4]], jnp.int32), 4)
+            == prefix_chunk_keys([1, 2, 3, 4], 4))
+    with pytest.raises(ValueError, match="page_size"):
+        prefix_chunk_keys([1], 0)
+
+
+def test_match_share_release_roundtrip():
+    pool = PagePool(n_pages=8, page_size=4, sharing=True)
+    keys = prefix_chunk_keys(list(range(8)), 4)
+    pages = pool.alloc(2)
+    for p, k in zip(pages, keys):
+        pool.register(p, k)
+    assert pool.match_prefix(keys) == (2, pages)
+    # longest-resident-run semantics: an unknown key stops the match
+    assert pool.match_prefix(keys + ["nope"]) == (2, pages)
+    assert pool.match_prefix(["nope"] + keys) == (0, [])
+    pool.share(pages)
+    assert pool.refcount(pages[0]) == 2
+    assert pool.used_pages == 2 and pool.logical_pages == 4
+    assert pool.shared_pages == 2
+    with pytest.raises(ValueError, match="shared"):
+        pool.free([pages[0]])  # aliased pages must go through release_ref
+    pool.release_ref(pages)  # drop the alias: nothing freed physically
+    assert pool.used_pages == 2 and pool.refcount(pages[0]) == 1
+    assert pool.match_prefix(keys) == (2, pages)  # still interned
+    pool.release_ref(pages)  # last reference frees + evicts the intern
+    assert pool.free_pages == 7
+    assert pool.match_prefix(keys) == (0, [])
+
+
+def test_sharing_disabled_pool_is_inert():
+    pool = PagePool(n_pages=8, page_size=4)
+    pages = pool.alloc(2)
+    keys = prefix_chunk_keys(list(range(8)), 4)
+    pool.register(pages[0], keys[0])  # no-op when sharing is off
+    assert pool.match_prefix(keys) == (0, [])
+    with pytest.raises(ValueError, match="sharing disabled"):
+        pool.share(pages)
+    pool.release_ref(pages)  # degrades to a plain free
+    assert pool.free_pages == 7
+
+
+def test_sharing_error_paths_and_first_writer_interning():
+    pool = PagePool(n_pages=8, page_size=4, sharing=True)
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.share([3])
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.register(3, "k")
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.release_ref([3])
+    # first writer wins: a duplicate key keeps the incumbent page so
+    # existing aliases of it stay valid
+    a, b = pool.alloc(2)
+    pool.register(a, "k")
+    pool.register(b, "k")
+    assert pool.match_prefix(["k"]) == (1, [a])
+
+
+def test_share_unshare_events_carry_tiling_and_refcounts():
+    log = PageOwnershipLog(n_pages=8)
+    pool = PagePool(n_pages=8, page_size=4, sharing=True, ownlog=log)
+    pages = pool.alloc(2)
+    pool.share(pages)
+    pool.release_ref(pages)   # unshare (rc 2 -> 1)
+    pool.release_ref(pages)   # last ref -> physical free
+    kinds = [e["kind"] for e in log.snapshot()["events"]]
+    assert kinds == ["alloc", "share", "unshare", "free"]
+    share_ev = log.snapshot()["events"][1]
+    # share moves no physical pages: tiling counts unchanged from alloc
+    assert share_ev["free_pages"] == 5 and share_ev["used_pages"] == 2
+    assert share_ev["refcounts"] == [2, 2]
+    unshare_ev = log.snapshot()["events"][2]
+    assert unshare_ev["refcounts"] == [1, 1]  # post-decrement
+    # disabled-sharing streams never carry the key at all
+    log2 = PageOwnershipLog(n_pages=8)
+    pool2 = PagePool(n_pages=8, page_size=4, ownlog=log2)
+    pool2.free(pool2.alloc(1))
+    assert all("refcounts" not in e for e in log2.snapshot()["events"])
 
 
 # -- scatter / gather -------------------------------------------------------
@@ -318,3 +415,112 @@ def test_engine_rejects_oversized_request():
     ids = jnp.zeros((1, 6), jnp.int32)
     with pytest.raises(ValueError, match="capacity"):
         eng.submit("big", ids, 3)  # 6 + 3 > 8
+
+
+def test_shared_prefix_churn_property(session_slo_engine):
+    """Seeded random admit/decode/preempt interleavings over a
+    shared-prefix request mix: after EVERY action the pool must tile
+    physically (free + unique used == allocatable), refcounts must
+    cover every slot-held page, the intern table must only point at
+    live pages, and the ownership stream must replay clean through the
+    page-lifetime prover.  At the end: zero physical leaks, a clean
+    final prover pass (orphan scan included), and bitwise-identical
+    tokens for two concurrently-decoded requests aliasing the same
+    prefix pages."""
+    from distributed_llm_scheduler_tpu.analysis.page_pass import (
+        analyze_pages,
+    )
+
+    eng = session_slo_engine
+    log = PageOwnershipLog(n_pages=eng.pool.n_pages)
+    try:
+        eng.pool.sharing = True  # rebind builds a pristine SHARING pool
+        eng.rebind_obs(ownlog=log)
+        assert eng.sharing
+
+        rng = np.random.RandomState(17)
+        system = [int(t) for t in rng.randint(1, 40, size=8)]
+        users = [[int(t) for t in rng.randint(1, 40, size=8)]
+                 for _ in range(4)]
+        prompts = {}
+
+        def prompt_for(i):
+            toks = system + users[i % 4]
+            if i % 2:  # every other request is a two-turn session
+                toks = toks + users[(i + 1) % 4]
+            return jnp.asarray([toks], jnp.int32)
+
+        def check():
+            occ = eng.page_occupancy()
+            assert occ["free_pages"] + occ["used_pages"] == occ["n_pages"]
+            pool = eng.pool
+            assert pool.logical_pages >= pool.used_pages
+            for s in range(eng.slots):
+                for p in eng._slot_pages[s]:
+                    assert pool.refcount(p) >= 1
+            for key, page in pool._intern.items():
+                assert page in pool._allocated
+                assert pool._page_key.get(page) == key
+            rep = analyze_pages(log, final=False)  # mid-run: no orphan scan
+            assert [d.code for d in rep.diagnostics] == []
+
+        nxt, resumed = 0, 0
+        for _ in range(48):
+            in_flight = [eng._slot_req[s] for s in range(eng.slots)
+                         if eng._slot_req[s] is not None]
+            roll = float(rng.rand())
+            if (roll < 0.45 and nxt < 10) or (not in_flight
+                                              and not eng._queue):
+                if nxt >= 10:
+                    break  # workload drained and nothing left to submit
+                rid = f"c{nxt}"
+                prompts[rid] = prompt_for(nxt)
+                eng.submit(rid, prompts[rid], int(rng.randint(2, 6)))
+                nxt += 1
+            elif roll < 0.62 and in_flight:
+                victim = in_flight[int(rng.randint(len(in_flight)))]
+                ev = eng.preempt(victim)
+                if int(ev["remaining"]) > 0:
+                    # deterministic resume: prompt + generated prefix
+                    # re-queued under a derived rid (greedy decode makes
+                    # the continuation exact)
+                    rid2 = f"{victim}.r{resumed}"
+                    resumed += 1
+                    prompts[rid2] = jnp.concatenate(
+                        [prompts[victim],
+                         jnp.asarray(ev["tokens"], jnp.int32)[None, :]],
+                        axis=1,
+                    )
+                    eng.submit(rid2, prompts[rid2], int(ev["remaining"]))
+            else:
+                eng.step_segment()
+            check()
+
+        eng.run()  # drain whatever churn left behind
+        check()
+        occ = eng.page_occupancy()
+        assert occ["free_pages"] == occ["n_pages"], "pages leaked"
+
+        # epilogue: a second identical prompt arriving one segment later
+        # must alias the first's freshly-interned pages (same-wave twins
+        # would both miss — nothing is interned when the batch forms)
+        # and decode to bitwise-identical token streams
+        twin = prompt_for(1)  # 24 tokens -> 2 shareable full pages
+        n_share = sum(1 for e in log.events if e["kind"] == "share")
+        # budget > seg_steps so za is still resident when zb arrives
+        eng.submit("za", twin, 8)
+        eng.step_segment()  # admit + intern za's pages
+        eng.submit("zb", twin, 8)
+        res = eng.run()
+        np.testing.assert_array_equal(res["za"], res["zb"])
+        kinds = [e["kind"] for e in log.snapshot()["events"]]
+        assert sum(1 for k in kinds if k == "share") > n_share
+        assert "cow" not in kinds
+        check()
+        assert eng.page_occupancy()["free_pages"] == occ["n_pages"]
+        # final pass WITH the orphan scan: every alloc found its free
+        assert [d.code for d in analyze_pages(log).diagnostics] == []
+    finally:
+        eng.pool.sharing = False  # next rebind builds a non-sharing pool
+        eng.attach_ownership_log(None)
+        eng.reset()

@@ -28,9 +28,15 @@ from distributed_llm_scheduler_tpu.models.kv_pages import (
     PagePool,
 )
 from distributed_llm_scheduler_tpu.serve.frontend import VirtualClock
-from distributed_llm_scheduler_tpu.serve.soak import inject_page_leak
+from distributed_llm_scheduler_tpu.serve.soak import (
+    inject_page_leak,
+    inject_refcount_underflow,
+)
 
 PROMPT = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+# two full pages at page_size 8 -> one shareable prefix page (the last
+# prompt token always re-runs, so only 15 tokens' worth can alias)
+PROMPT16 = jnp.asarray([list(range(1, 17))], jnp.int32)
 
 
 def _codes(rep):
@@ -158,6 +164,102 @@ def test_pgl005_protocol_and_tiling_violations():
     assert _codes(rep) == ["PGL005"]
 
 
+def test_pgl006_carried_refcount_disagrees_with_replay():
+    """The carried ``refcounts`` witness is checked against the
+    replayed counters — an under/overflowed pool counter cannot hide."""
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "share", [2], free_pages=4, used_pages=1,
+            refcounts=[3]),  # replay says 2
+    ], n_pages=6, final=False)
+    assert _codes(rep) == ["PGL006"]
+    d = rep.diagnostics[0]
+    assert "carries refcount 3 but the event stream replays to 2" \
+        in d.message
+    assert d.data == {"page": 2, "event": 1, "carried": 3, "replayed": 2}
+
+
+def test_pgl006_unshare_underflow_and_free_while_shared():
+    # dropping the only reference must free, not unshare
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "unshare", [2], free_pages=4, used_pages=1,
+            refcounts=[0]),
+    ], n_pages=6, final=False)
+    assert _codes(rep) == ["PGL006"]
+    assert "would underflow" in rep.diagnostics[0].message
+    # freeing a page other requests still alias
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "share", [2], free_pages=4, used_pages=1, refcounts=[2]),
+        _ev(2, "free", [2], free_pages=5, used_pages=0),
+    ], n_pages=6)
+    assert "PGL006" in _codes(rep)
+    assert any("other requests still reference it" in d.message
+               for d in rep.diagnostics)
+
+
+def test_pgl007_write_on_aliased_page_without_cow():
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "assign", [2], owner="r1", site="admit"),
+        _ev(2, "share", [2], free_pages=4, used_pages=1, refcounts=[2]),
+        _ev(3, "write", [2], owner="r1", site="decode"),
+    ], n_pages=6, final=False)
+    assert _codes(rep) == ["PGL007"]
+    d = rep.diagnostics[0]
+    assert d.task == "r1"
+    assert "aliased readers would observe the write" in d.message
+
+
+def test_pgl007_cow_split_golden_and_violations():
+    # the legal sequence: alloc dst -> cow -> unshare src -> write dst,
+    # with ownership transferring r1: src -> dst.  Replays clean.
+    clean = [
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "assign", [2], owner="r1", site="admit"),
+        _ev(2, "share", [2], free_pages=4, used_pages=1, refcounts=[2]),
+        _ev(3, "assign", [2], owner="r2", site="admit",
+            refcounts=[2]),
+        _ev(4, "alloc", [3], free_pages=3, used_pages=2),
+        _ev(5, "cow", [2, 3], owner="r1", site="decode"),
+        _ev(6, "unshare", [2], free_pages=3, used_pages=2,
+            refcounts=[1]),
+        _ev(7, "write", [3], owner="r1", site="cow"),
+        _ev(8, "release", [3], owner="r1", site="retire",
+            refcounts=[1]),
+        _ev(9, "free", [3], free_pages=4, used_pages=1),
+        _ev(10, "release", [2], owner="r2", site="retire",
+            refcounts=[1]),
+        _ev(11, "free", [2], free_pages=5, used_pages=0),
+    ]
+    assert _codes(analyze_pages(clean, n_pages=6)) == []
+    # a cow that doesn't name [src, dst]
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "cow", [2], owner="r1", site="decode"),
+    ], n_pages=6, final=False)
+    assert "PGL007" in _codes(rep)
+    assert "must name [src, dst]" in rep.diagnostics[0].message
+    # a cow whose destination never went through the allocator
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "assign", [2], owner="r1", site="admit"),
+        _ev(2, "cow", [2, 4], owner="r1", site="decode"),
+    ], n_pages=6, final=False)
+    assert "PGL007" in _codes(rep)
+    assert any("alloc-before-release" in d.message
+               for d in rep.diagnostics)
+    # a cow by a request that never owned the source
+    rep = analyze_pages([
+        _ev(0, "alloc", [2], free_pages=4, used_pages=1),
+        _ev(1, "assign", [2], owner="r1", site="admit"),
+        _ev(2, "alloc", [3], free_pages=3, used_pages=2),
+        _ev(3, "cow", [2, 3], owner="r9", site="decode"),
+    ], n_pages=6, final=False)
+    assert "PGL005" in _codes(rep)
+
+
 # -- the engine seam end-to-end --------------------------------------------
 def test_clean_run_replays_clean_with_tiling_proven(session_serve_engine):
     eng = session_serve_engine
@@ -226,6 +328,34 @@ def test_seam_off_is_bitwise_identical(session_serve_engine):
         assert np.array_equal(out_off[k], out_on[k])
     assert occ_off == occ_on
     assert snap_off == snap_on
+
+
+def test_underflow_injector_convicted_statically(session_serve_engine):
+    """The refcount fault injector — drops one reference the first time
+    a prefix page is shared — is convicted by the prover from a short
+    two-request run: the very next event carrying refcounts disagrees
+    with the replay (PGL006)."""
+    eng = session_serve_engine
+    log = PageOwnershipLog()
+    try:
+        eng.pool.sharing = True  # rebind builds a pristine SHARING pool
+        eng.rebind_obs(clock=VirtualClock(), ownlog=log)
+        pool = inject_refcount_underflow(eng)
+        eng.submit("a", PROMPT16, 8)
+        eng.step_segment()  # admit + intern a's full-prompt pages first
+        eng.submit("b", PROMPT16, 8)  # same prompt -> aliases a's page
+        eng.run()
+        assert pool.dropped, "the injector never fired"
+        rep = analyze_pages(log)
+        assert rep.exit_code == 1
+        assert "PGL006" in _codes(rep)
+        culprit = next(d for d in rep.diagnostics if d.code == "PGL006")
+        assert culprit.data["page"] == pool.dropped[0]
+    finally:
+        # rebind_obs undoes the injector (pristine pool, same geometry);
+        # flip sharing back off first so the pristine pool inherits it
+        eng.pool.sharing = False
+        eng.rebind_obs(clock=VirtualClock())
 
 
 def test_rebind_detaches_stale_log(session_serve_engine):

@@ -31,6 +31,8 @@ from distributed_llm_scheduler_tpu.serve import (  # noqa: E402
     prompt_token_ids,
     save_trace,
     schedule_digest,
+    session_arrivals,
+    session_prompt_token_ids,
     validate_trace_obj,
 )
 
@@ -110,6 +112,54 @@ def test_trace_roundtrip_and_validation(tmp_path):
     bad.write_text(json.dumps(obj))
     with pytest.raises(ValueError, match="malformed"):
         load_trace(str(bad))
+
+
+SESSION_KW = dict(
+    system_len=8, user_len=8, turns=2, max_new_tokens=(8,),
+    priorities=(0, 1), priority_weights=(0.3, 0.7),
+)
+
+
+def test_session_arrivals_shared_prefix_schedule():
+    a = session_arrivals(40.0, 8, 7, **SESSION_KW)
+    assert a == session_arrivals(40.0, 8, 7, **SESSION_KW)
+    assert len(a) == 16                      # n_sessions * turns
+    assert all(x.t <= y.t for x, y in zip(a, a[1:]))  # time-sorted
+    # rids are derived {prefix}{i}t{k}; turn k's prompt grows by one
+    # user chunk on top of the shared system prompt
+    for x in a:
+        sid, _, turn = x.rid.rpartition("t")
+        assert sid and turn.isdigit()
+        assert x.prompt_len == 8 + (int(turn) + 1) * 8
+    # plain Arrival rows: the dls.arrivals/1 machinery applies unchanged
+    assert validate_trace_obj(arrivals_to_json(a)) == []
+    assert schedule_digest(a) != schedule_digest(
+        session_arrivals(40.0, 8, 8, **SESSION_KW)
+    )
+    with pytest.raises(ValueError, match="rate_rps"):
+        session_arrivals(0.0, 8, 7, **SESSION_KW)
+    with pytest.raises(ValueError, match="turns"):
+        session_arrivals(40.0, 8, 7, system_len=8, user_len=8, turns=0)
+    with pytest.raises(ValueError, match="system_len"):
+        session_arrivals(40.0, 8, 7, system_len=0, user_len=8)
+
+
+def test_session_prompts_extend_bitwise():
+    kw = dict(system_len=8, user_len=8)
+    t0 = session_prompt_token_ids("s3t0", 16, 512, **kw)
+    t1 = session_prompt_token_ids("s3t1", 24, 512, **kw)
+    other = session_prompt_token_ids("s9t0", 16, 512, **kw)
+    assert t0.shape == (1, 16) and t1.shape == (1, 24)
+    # turn k's prompt is bitwise turn k-1's plus one chunk, and every
+    # session opens with the identical system tokens — the properties
+    # that make the workload prefix-shareable
+    np.testing.assert_array_equal(t1[:, :16], t0)
+    np.testing.assert_array_equal(other[:, :8], t0[:, :8])
+    assert not np.array_equal(other[:, 8:], t0[:, 8:])
+    with pytest.raises(ValueError, match="session rid"):
+        session_prompt_token_ids("nope", 16, 512, **kw)
+    with pytest.raises(ValueError, match="implies prompt_len"):
+        session_prompt_token_ids("s3t1", 16, 512, **kw)
 
 
 # -- engine: duplicate rids, occupancy, preemption -------------------------
@@ -245,6 +295,111 @@ def test_serve_run_deterministic_under_fixed_seed(serve_artifact):
     assert serve_artifact["deterministic"] is True
     assert serve_bench.gate_failures(serve_artifact) == []
     assert serve_bench.validate_serve_artifact(serve_artifact) == []
+
+
+# -- prefix sharing: the r17 gates ------------------------------------------
+def test_prefix_sharing_beats_disabled_with_exact_books(serve_artifact):
+    """The tentpole's headline: at equal offered load the sharing leg
+    strictly wins BOTH goodput and TTFT p99 over the sharing-disabled
+    leg, pages actually alias, the refcount books balance exactly, and
+    the ownership stream proves clean."""
+    px = serve_artifact["prefix"]
+    assert serve_bench.prefix_gate_failures(px) == []
+    sh, un = px["legs"]["shared"], px["legs"]["unshared"]
+    assert sh["goodput_tok_s"] > un["goodput_tok_s"]
+    assert sh["ttft_p99_ms"] < un["ttft_p99_ms"]
+    assert px["goodput_gain"] > 1.0
+    assert px["deterministic"] is True
+    acct = px["accounting"]
+    assert acct["shared"]["shared_page_hits"] >= 1
+    assert acct["unshared"]["shared_page_hits"] == 0
+    for name in ("shared", "unshared"):
+        a = acct[name]
+        assert a["logical_pages_peak"] >= a["physical_pages_peak"]
+        assert a["physical_pages_end"] == a["logical_pages_end"] == 0
+        assert px["page_pass"][name] == []
+        assert px["legs"][name]["pages_leaked"] == 0
+    # the flattened regression metrics mirror the nested blocks exactly
+    assert (serve_artifact["serve.prefix.goodput_tok_s"]
+            == sh["goodput_tok_s"])
+    assert (serve_artifact["serve.prefix.goodput_gain"]
+            == px["goodput_gain"])
+    assert serve_artifact["serve.prefix.pages_leaked"] == 0
+
+
+def test_sharing_toggle_changes_no_tokens(_engine):
+    """Sharing is a memory-management change ONLY: the same staggered
+    two-request workload decodes to bitwise-identical tokens with the
+    intern table on and off."""
+    eng, _pool = _engine()
+    prompt = jnp.asarray([list(range(1, 17))], jnp.int32)
+
+    def leg():
+        eng.submit("a", prompt, 8)
+        eng.step_segment()   # admit a first so b CAN alias when sharing
+        eng.submit("b", prompt, 8)
+        out = eng.run()
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    off = leg()
+    assert eng.summary().get("prefix_sharing") is None
+    try:
+        eng.pool.sharing = True   # rebind inherits the live pool's mode
+        eng.rebind_obs(clock=VirtualClock())
+        assert eng.sharing
+        on = leg()
+        assert eng.metrics.counter("decode.prefix_shared_pages").value >= 1
+    finally:
+        eng.pool.sharing = False
+        eng.rebind_obs(clock=VirtualClock())
+    assert off.keys() == on.keys()
+    for k in off:
+        np.testing.assert_array_equal(off[k], on[k])
+
+
+def test_forced_alias_triggers_cow_and_keeps_tokens_bitwise(_engine):
+    """The COW seam: admission structurally never writes a shared page,
+    so FORCE an alias onto a page in the coming write range — the
+    engine must alloc-copy-release (recording ``cow`` then ``write``),
+    keep the aliased content intact, and still emit the exact token
+    stream of an unforced run."""
+    from distributed_llm_scheduler_tpu.analysis import analyze_pages
+    from distributed_llm_scheduler_tpu.models.kv_pages import (
+        PageOwnershipLog,
+    )
+
+    eng, _pool = _engine()
+    prompt = jnp.asarray([[5, 4, 3, 2, 1, 2, 3, 4]], jnp.int32)
+    eng.submit("ref", prompt, 16)
+    ref = eng.run()["ref"]
+
+    log = PageOwnershipLog()
+    try:
+        eng.pool.sharing = True
+        eng.rebind_obs(clock=VirtualClock(), ownlog=log)
+        eng.submit("vic", prompt, 16)
+        eng.step_segment()            # 4 of 16 decoded: length 12
+        s = next(i for i in range(eng.slots)
+                 if eng._slot_req[i] == "vic")
+        li = int(eng.lengths[s]) // eng.page_size  # the page being written
+        src = int(eng.page_table[s, li])
+        eng.pool.share([src])         # the forced alias
+        out = eng.run()["vic"]        # next segment must COW-split first
+        np.testing.assert_array_equal(out, np.asarray(ref))
+        kinds = [e["kind"] for e in log.events]
+        assert "cow" in kinds
+        assert eng.metrics.counter("decode.cow_splits").value >= 1
+        # the engine moved off src; the forced reference still pins it
+        assert eng.pool.refcount(src) == 1
+        eng.pool.release_ref([src])
+        occ = eng.page_occupancy()
+        assert occ["free_pages"] == occ["n_pages"]
+        # the full forced stream replays clean: alloc-before-release
+        # ordering, ownership transfer, and the final free all prove
+        assert [d.code for d in analyze_pages(log).diagnostics] == []
+    finally:
+        eng.pool.sharing = False
+        eng.rebind_obs(clock=VirtualClock())
 
 
 def test_frontend_rejects_bad_config(_engine):

@@ -5,12 +5,10 @@ eviction bounds, and the ``slo`` CLI's exit-code semantics."""
 
 import json
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_llm_scheduler_tpu import Cluster, get_scheduler
 from distributed_llm_scheduler_tpu.obs import (
     FlightRecorder,
     MetricsRegistry,
@@ -274,33 +272,13 @@ def test_ambient_flight_disabled_by_default(monkeypatch):
 # Engine integration: the bitwise record-vs-histogram contract
 
 
-def _build_engine(**obs):
-    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
-    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
-        build_paged_decode_dag,
-    )
-    from distributed_llm_scheduler_tpu.models import gpt2
-    from distributed_llm_scheduler_tpu.models.kv_pages import PagePool
-
-    cfg = gpt2.GPT2Config.tiny()
-    slots, ps, n_pages, ppseq = 2, 8, 32, 4
-    dag = build_paged_decode_dag(
-        cfg, slots=slots, page_size=ps, n_pages=n_pages, pages_per_seq=ppseq
-    )
-    params = dag.init_params()
-    weights = {
-        k: v for k, v in params.items()
-        if not (k.startswith("cache_") or k == "page_table")
-    }
-    cluster = Cluster.from_jax_devices(jax.devices()[:1])
-    backend = DeviceBackend(cluster)
-    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    pool = PagePool(n_pages=n_pages, page_size=ps)
-    eng = backend.paged_decode_engine(
-        dag.graph, sched, cfg, weights, pool,
-        slots=slots, pages_per_seq=ppseq, seg_steps=4, **obs,
-    )
-    return eng, pool
+def _bind_engine(eng, **obs):
+    """Point the session-compiled tiny engine at this test's
+    observability surfaces.  ``rebind_obs`` wipes run state and swaps
+    in a pristine pool, so each call is equivalent to a fresh build —
+    minus the XLA compile the session already paid."""
+    eng.rebind_obs(**obs)
+    return eng
 
 
 def _scripted_run(eng, clk):
@@ -315,7 +293,8 @@ def _scripted_run(eng, clk):
     eng.step_segment()
 
 
-def test_engine_records_bitwise_match_histograms(monkeypatch):
+def test_engine_records_bitwise_match_histograms(
+        monkeypatch, session_slo_engine):
     """TTFT/TPOT derived from RequestRecords must equal — bitwise, not
     approximately — the samples the engine's histograms observed for
     the same run (they come from the same clock reads)."""
@@ -324,8 +303,8 @@ def test_engine_records_bitwise_match_histograms(monkeypatch):
     reset_ambient()
     clk = FakeClock(0.0)
     reg = MetricsRegistry()
-    eng, pool = _build_engine(trace=Tracer(clock=clk), metrics=reg,
-                              clock=clk)
+    eng = _bind_engine(session_slo_engine, tracer=Tracer(clock=clk),
+                       metrics=reg, clock=clk)
     _scripted_run(eng, clk)
 
     snap = eng.reqlog.snapshot()
@@ -359,47 +338,52 @@ def test_engine_records_bitwise_match_histograms(monkeypatch):
     assert gauge["max"] == max(depth_track)
 
 
-def test_engine_instrumented_run_bit_identical_and_reset(monkeypatch):
+def test_engine_instrumented_run_bit_identical_and_reset(
+        monkeypatch, session_slo_engine):
     """A flight-recorded run must produce bit-identical outputs and page
     accounting to a bare run, and reset() starts a fresh request log
-    while the flight ring survives."""
+    while the flight ring survives.  One session engine serves all three
+    legs via rebind_obs — each rebind is a fresh build minus the
+    compile, so the cross-leg comparisons still hold bitwise."""
     monkeypatch.delenv("DLS_TRACE", raising=False)
     monkeypatch.delenv("DLS_FLIGHT", raising=False)
     reset_ambient()
+    eng = session_slo_engine
     clk_a = FakeClock(0.0)
-    eng_a, pool_a = _build_engine(clock=clk_a)
-    assert eng_a.tracer is None and eng_a.flight is None  # disabled path
-    _scripted_run(eng_a, clk_a)
+    _bind_engine(eng, clock=clk_a)
+    assert eng.tracer is None and eng.flight is None  # disabled path
+    _scripted_run(eng, clk_a)
+    results_a = {rid: np.asarray(v) for rid, v in eng.results.items()}
+    free_a = eng.pool.free_pages
 
     clk_b = FakeClock(0.0)
     fr = FlightRecorder(capacity=64, request_capacity=8, clock=clk_b)
-    eng_b, pool_b = _build_engine(clock=clk_b, flight=fr)
-    assert eng_b.tracer is fr.tracer  # the ring alone carries spans
-    _scripted_run(eng_b, clk_b)
+    _bind_engine(eng, clock=clk_b, flight=fr)
+    assert eng.tracer is fr.tracer  # the ring alone carries spans
+    _scripted_run(eng, clk_b)
 
-    assert set(eng_a.results) == set(eng_b.results)
-    for rid in eng_a.results:
-        np.testing.assert_array_equal(eng_a.results[rid],
-                                      eng_b.results[rid])
-    assert pool_a.free_pages == pool_b.free_pages
+    assert set(results_a) == set(eng.results)
+    for rid in results_a:
+        np.testing.assert_array_equal(results_a[rid], eng.results[rid])
+    assert free_a == eng.pool.free_pages
     # the flight ring stayed within its bound and captured the run
     assert len(fr.tracer.events) <= 64
     assert len(fr.reqlog) <= 8
     assert {r.rid for r in fr.reqlog.records()} == {"r0", "r1"}
 
     # reset(): fresh engine log, surviving flight ring
-    old_log = eng_b.reqlog
-    eng_b.reset()
-    assert eng_b.reqlog is not old_log and len(eng_b.reqlog) == 0
+    old_log = eng.reqlog
+    eng.reset()
+    assert eng.reqlog is not old_log and len(eng.reqlog) == 0
     assert len(fr.reqlog) == 2
 
     # explicit tracer + flight -> teed, both sinks see the same events
     clk_c = FakeClock(0.0)
     tr = Tracer(clock=clk_c)
     fr_c = FlightRecorder(capacity=64, clock=clk_c)
-    eng_c, _ = _build_engine(clock=clk_c, trace=tr, flight=fr_c)
-    assert isinstance(eng_c.tracer, TeeTracer)
-    _scripted_run(eng_c, clk_c)
+    _bind_engine(eng, clock=clk_c, tracer=tr, flight=fr_c)
+    assert isinstance(eng.tracer, TeeTracer)
+    _scripted_run(eng, clk_c)
     assert len(tr.events) > 0
     assert list(fr_c.tracer.events) == tr.events[-len(fr_c.tracer.events):]
 
