@@ -456,6 +456,12 @@ def cmd_lint(args) -> int:
         # avals as initialized weights without materializing any arrays
         params=param_specs,
         graph_input=getattr(dag, "input_spec", None),
+        chunk_tokens=getattr(args, "chunk_tokens", None),
+        decode_budget=(
+            cfg.batch * args.seg_steps
+            if getattr(args, "chunk_tokens", None) is not None
+            else None
+        ),
     )
     if schedule.failed and not args.json:
         print(f"note: scheduler failed {len(schedule.failed)} task(s) "
@@ -1595,6 +1601,7 @@ def cmd_serve(args) -> int:
         mcfg, weights, pool, slots=slots, pages_per_seq=ppseq,
         seg_steps=4, clock=clock, flight=flight,
         attention_impl=args.attention_impl,
+        chunk_tokens=args.chunk_tokens,
     )
     fe = ServingFrontend(
         eng, arrivals, policy, admission=args.admission,
@@ -1649,6 +1656,7 @@ def cmd_soak(args) -> int:
             window_s=args.window, percentile=args.percentile,
             capacity=args.capacity, real_clock=args.real_clock,
             attention_impl=args.attention_impl,
+            chunk_tokens=args.chunk_tokens,
         )
         cfg.validate()
         if args.inject_leak is not None and args.inject_leak < 1:
@@ -2147,6 +2155,18 @@ def main(argv=None) -> int:
                    help="rows per KV page for --paged (default 16); "
                         "DEC005 warns when the geometry makes the fused "
                         "Pallas kernel ineligible (gather fallback)")
+    p.add_argument("--chunk-tokens", type=int, default=None,
+                   dest="chunk_tokens", metavar="N",
+                   help="with --paged: also lint the chunked-prefill "
+                        "chunk size (DEC006 warns when the ragged "
+                        "multi-token-q kernel is ineligible at this "
+                        "size, or when one chunk exceeds the "
+                        "slots*seg-steps per-segment prefill budget)")
+    p.add_argument("--seg-steps", type=int, default=8,
+                   dest="seg_steps", metavar="K",
+                   help="decode steps per segment for the DEC006 budget "
+                        "check (default 8, the engine default; --batch "
+                        "sets the slot count)")
     p.add_argument("--fix", action="store_true",
                    help="apply mechanical fixes before linting "
                         "(DAG003 duplicate-dependency dedup keeping the "
@@ -2422,6 +2442,14 @@ def main(argv=None) -> int:
                         "engine (default: op-level auto — fused Pallas "
                         "kernel on TPU when eligible, XLA gather "
                         "otherwise)")
+    p.add_argument("--chunk-tokens", type=int, default=None,
+                   dest="chunk_tokens", metavar="N",
+                   help="chunked prefill: prompts longer than N tokens "
+                        "admit with first-chunk pages only and prefill "
+                        "N tokens per segment fused into the decode "
+                        "waves (default: whole-prompt admission; "
+                        "greedy tokens are bitwise identical either "
+                        "way)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -2478,6 +2506,10 @@ def main(argv=None) -> int:
                    choices=("auto", "xla", "pallas", "pallas_interpret"),
                    help="paged attention implementation baked into the "
                         "engine (default: op-level auto)")
+    p.add_argument("--chunk-tokens", type=int, default=None,
+                   dest="chunk_tokens", metavar="N",
+                   help="chunked prefill chunk size for the soak engine "
+                        "(default: whole-prompt admission)")
     p.set_defaults(fn=cmd_soak)
 
     p = sub.add_parser(

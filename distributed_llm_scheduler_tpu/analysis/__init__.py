@@ -130,6 +130,8 @@ def analyze(
     page_events: Any = None,
     request_log: Any = None,
     request_log_final: bool = False,
+    chunk_tokens: Optional[int] = None,
+    decode_budget: Optional[int] = None,
 ) -> AnalysisReport:
     """Run every pass the provided inputs make applicable.
 
@@ -162,7 +164,9 @@ def analyze(
     # quantization spec table or the typecheck param table carries them
     rep.extend(
         analyze_decode(graph, cluster, schedule,
-                       param_specs=param_specs or params)
+                       param_specs=param_specs or params,
+                       chunk_tokens=chunk_tokens,
+                       decode_budget=decode_budget)
     )
     if cluster is not None and schedule is not None:
         rep.extend(analyze_schedule(graph, cluster, schedule))

@@ -32,6 +32,8 @@ def analyze_decode(
     cluster: Optional[Cluster] = None,
     schedule: Optional[Schedule] = None,
     param_specs: Optional[Dict[str, Any]] = None,
+    chunk_tokens: Optional[int] = None,
+    decode_budget: Optional[int] = None,
 ) -> AnalysisReport:
     """Decode-loop composability checks (no-op on non-decode graphs).
 
@@ -56,6 +58,16 @@ def analyze_decode(
       to the XLA gather path.  The message names each violated tiling
       constraint.  A warning, never a gate: the gather path is correct,
       just slower.
+    * ``DEC006`` (warning, needs ``chunk_tokens``): the configured
+      chunked-prefill chunk size is degenerate — either it violates the
+      ragged multi-token-q kernel's tiling constraints
+      (``paged_kernel_constraints(..., q_tokens=chunk_tokens)``), so
+      every chunk wave silently runs the XLA gather path, or it exceeds
+      ``decode_budget`` (the engine's per-segment decode-token capacity
+      ``slots * seg_steps``), so a single chunk monopolizes the
+      segment's prefill budget and chunking degenerates to one chunk
+      per segment regardless of load.  Like DEC005, a warning and never
+      a gate: the engine's output is bitwise-correct either way.
     """
     rep = AnalysisReport()
     tasks = graph.tasks()
@@ -140,6 +152,7 @@ def analyze_decode(
             )
 
     # DEC005: fused-kernel eligibility of the pool geometry --------------
+    pool_spec = None
     if paged and param_specs:
         pool_spec = next(
             (
@@ -172,6 +185,43 @@ def analyze_decode(
                         "constraints": list(violated),
                     },
                 )
+
+    # DEC006: chunked-prefill chunk-size degeneracy ----------------------
+    if paged and chunk_tokens is not None:
+        problems = []
+        data: Dict[str, Any] = {"chunk_tokens": int(chunk_tokens)}
+        if pool_spec is not None:
+            from ..ops.attention import paged_kernel_constraints
+
+            _n_pages, page_size, n_kv, hd = pool_spec.shape
+            ragged_violated = paged_kernel_constraints(
+                page_size, hd, n_kv, dtype=pool_spec.dtype,
+                q_tokens=int(chunk_tokens),
+            )
+            if ragged_violated:
+                problems.append(
+                    "the ragged multi-token-q kernel is ineligible at "
+                    f"this chunk size (every chunk wave silently runs "
+                    "the XLA gather path): " + "; ".join(ragged_violated)
+                )
+                data["constraints"] = list(ragged_violated)
+        if decode_budget is not None and chunk_tokens > decode_budget:
+            problems.append(
+                f"chunk_tokens {chunk_tokens} exceeds the per-segment "
+                f"decode-token capacity {decode_budget} (slots * "
+                "seg_steps): one chunk monopolizes each segment's "
+                "prefill budget, so chunked admission degenerates to "
+                "one chunk per segment regardless of load"
+            )
+            data["decode_budget"] = int(decode_budget)
+        if problems:
+            rep.add(
+                "DEC006",
+                Severity.WARNING,
+                "chunked-prefill chunk size is degenerate: "
+                + " AND ".join(problems),
+                data=data,
+            )
 
     # DEC004: per-step KV residency payload ------------------------------
     kv_bytes: Dict[str, int] = {}

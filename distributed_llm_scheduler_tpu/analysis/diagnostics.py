@@ -70,6 +70,8 @@ CODES: Dict[str, str] = {
     "DEC004": "per-step KV-cache residency (informational)",
     "DEC005": "paged geometry ineligible for the fused Pallas kernel "
               "(silent gather fallback)",
+    "DEC006": "degenerate chunked-prefill chunk size (ragged kernel "
+              "ineligible or chunk exceeds the per-segment budget)",
     # -- quantization dtype flow (quant_pass) ---------------------------
     "QNT001": "QParam with wrong component dtypes",
     "QNT002": "QParam scale shape matches no known layout",

@@ -336,6 +336,7 @@ class PagedDecodeEngine:
         memprof: Any = None,
         flight: Any = None,
         attention_impl: Optional[str] = None,
+        chunk_tokens: Optional[int] = None,
     ):
         import numpy as np
 
@@ -367,6 +368,29 @@ class PagedDecodeEngine:
         self.page_size = pool.page_size
         self.capacity = pages_per_seq * pool.page_size
         self.seg_steps = seg_steps
+        # chunked prefill: prompts longer than this admit in fixed-token
+        # chunks co-scheduled with decode segments instead of one whole-
+        # prompt wave.  None (the default) keeps whole-prompt admission
+        # — every pre-chunking workload is bit-identical.  Mutable: the
+        # serve bench toggles it between legs like ``pool.sharing``.
+        if chunk_tokens is not None and chunk_tokens < 1:
+            raise ValueError(
+                f"chunk_tokens must be >= 1, got {chunk_tokens}"
+            )
+        self.chunk_tokens = chunk_tokens
+        # per-slot in-progress prefill state: slot -> {rid, ids (np
+        # (1, P)), P, max_new, next} where ``next`` is the count of
+        # prompt tokens already prefilled+scattered.  The slot is
+        # occupied (``_slot_req`` set) but decodes nothing
+        # (``remaining == 0`` diverts its segment writes to the trash
+        # page) until the last chunk folds.
+        self._chunk_state: Dict[int, Dict[str, Any]] = {}
+        self._chunk_rr = 0
+        # virtual-time seam: when set, called with the REAL token count
+        # right before every prefill dispatch (whole wave, stitched
+        # tail, or chunk) so a VirtualClock frontend can charge prefill
+        # compute time proportional to tokens.  None costs nothing.
+        self.prefill_time_charge: Optional[Callable[[int], None]] = None
         self._np = np
         n_layers, n_kv, hd = _cd(config)
         self.n_layers = n_layers
@@ -501,6 +525,13 @@ class PagedDecodeEngine:
                     self.memprof.free(
                         self._mem_node, f"kv:{self._slot_req[s]}"
                     )
+        # the KV arrays below are REBUILT, so retained prefix intern
+        # entries would point at zeroed pages — and a warm cache makes
+        # same-seed repeat runs diverge.  Fault-injector wrappers may
+        # not expose the method; pristine pools always do.
+        drop = getattr(self.pool, "drop_cached", None)
+        if drop is not None:
+            drop()
         n_layers = self.n_layers
         n_kv, hd = self.pools["cache_k_0"].shape[2:]
         self.pools = init_paged_kv(
@@ -521,6 +552,8 @@ class PagedDecodeEngine:
         self.segments_run = 0
         self._submit_t = {}
         self._first_tok_t = {}
+        self._chunk_state = {}
+        self._chunk_rr = 0
         # fresh request log per run (benches reset between reps); the
         # flight ring deliberately survives — it is the always-on
         # last-N record across runs
@@ -586,6 +619,9 @@ class PagedDecodeEngine:
             sharing=bool(getattr(self.pool, "sharing", False)),
         )
         self.attach_ownership_log(ownlog)
+        # the hook belongs to the leg that set it (a frontend with a
+        # virtual clock); a re-bound engine starts uncharged
+        self.prefill_time_charge = None
         self.__dict__.pop("step_segment", None)
         # reset() rebuilds pools/tables/reqlog against the just-bound
         # clock and flight sinks
@@ -634,8 +670,52 @@ class PagedDecodeEngine:
         keys = prefix_chunk_keys(
             prompt_ids, self.page_size
         )[:h_max]
-        h, _ = self.pool.match_prefix(keys)
-        return need - h
+        h, spages = self.pool.match_prefix(keys)
+        # a matched page that is CACHED-FREE (LRU-retained intern entry)
+        # still satisfies the prefix, but reviving it consumes one
+        # free-list page — count it as physical demand or the headroom
+        # check would over-admit and MemoryError mid-wave
+        is_cached = getattr(self.pool, "is_cached", None)
+        revive = (
+            sum(1 for p in spages if is_cached(p))
+            if is_cached is not None else 0
+        )
+        return need - h + revive
+
+    def chunk_eligible(self, prompt_len: int) -> bool:
+        """Whether a prompt admits CHUNKED: chunking is on, the prompt
+        is longer than one chunk, and the padded chunk grid fits the
+        per-slot capacity (the final chunk is padded to ``chunk_tokens``
+        rows, so ``ceil(P/chunk) * chunk`` dense-cache rows must exist —
+        otherwise the request falls back to whole-prompt admission)."""
+        ct = self.chunk_tokens
+        if ct is None or prompt_len <= ct:
+            return False
+        return -(-prompt_len // ct) * ct <= self.capacity
+
+    def admission_pages_needed(
+        self, prompt_ids: Any, max_new_tokens: int
+    ) -> int:
+        """Free-list pages admission must find for this request NOW:
+        the first chunk only when it admits chunked (later chunks alloc
+        lazily per segment), the fresh-tail footprint otherwise.  The
+        serving frontend's backlog check calls this so its headroom
+        arithmetic matches the engine allocator's."""
+        from ..models.kv_pages import pages_needed
+
+        P = int(prompt_ids.shape[1])
+        if self.chunk_eligible(P):
+            return pages_needed(
+                min(self.chunk_tokens, P), self.page_size
+            )
+        return self.fresh_pages_needed(prompt_ids, max_new_tokens)
+
+    def is_prefilling(self, rid: Any) -> bool:
+        """Whether ``rid`` holds a slot mid-chunked-prefill.  Such a
+        request is NOT preemptible — it has produced no resumable
+        prefix yet (no first token), so eviction would only waste the
+        chunks already scattered."""
+        return any(st["rid"] == rid for st in self._chunk_state.values())
 
     def _ensure_exclusive(self) -> None:
         """Copy-on-write guard before a segment: any page the coming
@@ -779,6 +859,9 @@ class PagedDecodeEngine:
         }
         if self.sharing:
             out["prefix_sharing"] = True
+        if self.chunk_tokens is not None:
+            out["chunk_tokens"] = self.chunk_tokens
+            out["prefilling"] = len(self._chunk_state)
         return out
 
     def submit(self, rid: Any, prompt_ids: Any, max_new_tokens: int) -> None:
@@ -873,6 +956,8 @@ class PagedDecodeEngine:
         # encounter of a compile class this run counts, warm or not
         if key not in self._prefill_cache:
             self._prefill_cache[key] = fn
+        if self.prefill_time_charge is not None:
+            self.prefill_time_charge(b * P)
         first, self.pools = fn(prompt_ids, self.pools, jnp.asarray(pt_rows))
         return first
 
@@ -958,11 +1043,261 @@ class PagedDecodeEngine:
         if key not in self._prefill_cache:
             self._prefill_cache[key] = fn
         tail = prompt_ids[:, h * self.page_size:]
+        if self.prefill_time_charge is not None:
+            self.prefill_time_charge(b * (P - h * self.page_size))
         first, self.pools = fn(
             tail, self.pools,
             jnp.asarray(shared_rows), jnp.asarray(wt_rows),
         )
         return first
+
+    # -- chunked prefill (co-scheduled with decode segments) ---------------
+    def _chunk_prefill(self, ids_chunk, pt_row, base: int, creal: int):
+        """Run ONE prefill chunk for one slot: gather the slot's pages
+        into a dense per-slot cache, run the transformer over the
+        ``chunk_tokens`` chunk at traced ``pos_start = base``, and
+        scatter every page back through the slot's table row.
+
+        ONE compile class per ``("chunk", chunk_tokens, 1, impl)`` —
+        prompt length, chunk index, and the final chunk's real length
+        ``creal`` are all DATA (the final chunk is padded to
+        ``chunk_tokens`` with token 0; causal masking keeps pad rows out
+        of every real row's scores, and their K/V rows land at positions
+        ``>= P`` that stay masked until decode overwrites them).  The
+        gather covers ALL ``pages_per_seq`` table entries (trash entries
+        gather masked garbage; the scatter-back writes it harmlessly to
+        the trash page) so page count is data too.
+
+        Bitwise contract: the dense cache has exactly the per-slot
+        ``capacity`` rows a whole-prompt prefill uses, positions
+        ``[0, base)`` hold the bytes the earlier chunks scattered, and
+        ``forward_cached`` masks cache columns beyond the write cursor
+        AFTER the scores — the same stitching argument as
+        :meth:`_prefill_scatter_shared`, so the chunk's rows, the final
+        logits row, and every downstream decode step match a
+        whole-prompt run bit for bit."""
+        from ..frontend.decode_dag import cache_dims as _cd
+        from ..models import decode as _decode
+        from ..parallel.decode import _family_of, _module_for
+
+        key = ("chunk", self.chunk_tokens, 1, self.attention_impl)
+        fn = self._prefill_store.get(key)
+        if fn is None:
+            mod = _module_for(_family_of(self.config))
+            n_layers, n_kv, hd = _cd(self.config)
+            cap, cfg = self.capacity, self.config
+            ppseq, ps = self.pages_per_seq, self.page_size
+
+            w = self.weights  # bound constants, same as the segment fn
+
+            def _fn(ids, pools, pages, pos0, creal):
+                cache = _decode.init_cache(
+                    n_layers, 1, n_kv, cap, hd, cfg.dtype
+                )
+                for i in range(n_layers):
+                    for kind in ("k", "v"):
+                        poolarr = pools[f"cache_{kind}_{i}"]
+                        rows = jnp.take(poolarr, pages, axis=0)
+                        rows = rows.reshape(1, cap, n_kv, hd)
+                        rows = rows.transpose(0, 2, 1, 3)
+                        buf = cache[kind]
+                        cache[kind] = buf.at[i].set(rows.astype(buf.dtype))
+                logits, cache = mod.forward_cached(
+                    w, ids, cache, pos0, cfg
+                )
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, creal - 1, 1, keepdims=False
+                )
+                first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                new = dict(pools)
+                for i in range(n_layers):
+                    for kind in ("k", "v"):
+                        rows = cache[kind][i].transpose(0, 2, 1, 3)
+                        paged = rows.reshape(ppseq, ps, n_kv, hd)
+                        poolarr = new[f"cache_{kind}_{i}"]
+                        new[f"cache_{kind}_{i}"] = poolarr.at[pages].set(
+                            paged.astype(poolarr.dtype), mode="drop"
+                        )
+                return first, new
+
+            fn = jax.jit(_fn, donate_argnums=(1,))
+            self._prefill_store[key] = fn
+        if key not in self._prefill_cache:
+            self._prefill_cache[key] = fn
+        if self.prefill_time_charge is not None:
+            self.prefill_time_charge(int(creal))
+        first, self.pools = fn(
+            ids_chunk, self.pools, jnp.asarray(pt_row, jnp.int32),
+            jnp.int32(base), jnp.int32(creal),
+        )
+        return first
+
+    def _admit_chunked(self, s: int) -> None:
+        """Admit the queue head into slot ``s`` in CHUNK mode: the slot
+        and the FIRST chunk's pages are claimed now; prefill itself
+        happens one chunk per segment in :meth:`_advance_chunks`.  The
+        slot decodes nothing (``remaining == 0``) until the last chunk
+        folds, and first-token delivery fires there."""
+        from ..models.kv_pages import TRASH_PAGE, pages_needed
+
+        rid, ids, max_new = self._queue.pop(0)
+        P = int(ids.shape[1])
+        need = pages_needed(min(self.chunk_tokens, P), self.page_size)
+        pages = self.pool.alloc(need)
+        t0 = self._clock()
+        self._slot_req[s] = rid
+        self._slot_pages[s] = list(pages)
+        # the WHOLE table row is rewritten: stale entries from the
+        # slot's previous occupant would make the chunk prefill's
+        # scatter-back land in pages other requests now own
+        for i in range(self.pages_per_seq):
+            self.page_table[s, i] = (
+                pages[i] if i < len(pages) else TRASH_PAGE
+            )
+        self.lengths[s] = 0
+        self.cur_tok[s, 0] = 0
+        self.remaining[s] = 0
+        self._chunk_state[s] = {
+            "rid": rid, "ids": self._np.asarray(ids), "P": P,
+            "max_new": max_new, "next": 0,
+        }
+        if self.memprof is not None:
+            # full-horizon footprint, like whole-prompt admission: the
+            # profiler tracks the request's eventual residency, not the
+            # lazy alloc schedule
+            self.memprof.alloc(
+                self._mem_node, f"kv:{rid}",
+                pages_needed(P + max_new, self.page_size)
+                * self._page_bytes,
+                "kv_pages",
+            )
+        if self.ownlog is not None:
+            if self.sharing:
+                self.ownlog.record(
+                    "assign", pages, owner=str(rid), site="admit",
+                    refcounts=[self.pool.refcount(p) for p in pages],
+                )
+            else:
+                self.ownlog.record(
+                    "assign", pages, owner=str(rid), site="admit"
+                )
+        for rl in self._reqlogs:
+            rl.admit(rid, t0)
+        self.metrics.counter("decode.chunk_admitted").inc()
+        if self.tracer is not None:
+            self.tracer.instant(
+                "admit_chunked", track="decode", cat="decode", t=t0,
+                rid=str(rid), prompt_len=P,
+            )
+        self._emit_pool_occupancy()
+        self._emit_queue_depth()
+
+    def _advance_chunks(self, budget: Optional[int] = None) -> int:
+        """Advance pending prefills by up to ``budget`` prompt tokens
+        this segment — the per-segment prefill token budget that keeps
+        a long prompt from starving in-flight decode.  The default
+        budget is the segment's own decode-token capacity
+        ``slots * seg_steps`` (floored at one chunk so progress is
+        always possible): prefill may consume at most as many
+        model-forward tokens per segment as the decode work it rides
+        alongside.  Round-robin across prefilling slots; a slot whose
+        next chunk cannot get its pages stalls (``decode.chunk_stalls``)
+        and retries next segment without blocking the others.  Returns
+        tokens prefilled."""
+        if not self._chunk_state:
+            return 0
+        from ..models.kv_pages import pages_needed
+
+        ct = self.chunk_tokens
+        if budget is None:
+            budget = max(ct, self.slots * self.seg_steps)
+        advanced = 0
+        order = sorted(self._chunk_state)
+        n = len(order)
+        rr = self._chunk_rr % n
+        for k in range(n):
+            if budget <= 0:
+                break
+            s = order[(rr + k) % n]
+            st = self._chunk_state[s]
+            P, base = st["P"], st["next"]
+            C = min(ct, P - base)
+            if C > budget:
+                break
+            final = base + C >= P
+            target_rows = P + st["max_new"] if final else base + C
+            need = pages_needed(target_rows, self.page_size) - len(
+                self._slot_pages[s]
+            )
+            if need > 0:
+                if not self.pool.can_alloc(need):
+                    self.metrics.counter("decode.chunk_stalls").inc()
+                    continue
+                fresh = self.pool.alloc(need)
+                k0 = len(self._slot_pages[s])
+                self._slot_pages[s].extend(fresh)
+                for i, p in enumerate(fresh):
+                    self.page_table[s, k0 + i] = p
+                if self.ownlog is not None:
+                    if self.sharing:
+                        self.ownlog.record(
+                            "assign", fresh, owner=str(st["rid"]),
+                            site="admit",
+                            refcounts=[
+                                self.pool.refcount(p) for p in fresh
+                            ],
+                        )
+                    else:
+                        self.ownlog.record(
+                            "assign", fresh, owner=str(st["rid"]),
+                            site="admit",
+                        )
+            chunk = self._np.zeros((1, ct), self._np.int32)
+            chunk[0, :C] = st["ids"][0, base:base + C]
+            ev = None
+            if self.tracer is not None:
+                ev = self.tracer.begin(
+                    "prefill_chunk", track="decode", cat="decode",
+                    rid=str(st["rid"]), base=base, tokens=C,
+                )
+            first = self._chunk_prefill(
+                jnp.asarray(chunk), self.page_table[s], base, C
+            )
+            if ev is not None:
+                self.tracer.end(ev)
+            st["next"] = base + C
+            advanced += C
+            budget -= C
+            self.metrics.counter("decode.chunk_prefill_tokens").inc(C)
+            self.metrics.counter("decode.chunk_waves").inc()
+            if st["next"] >= P:
+                self._fold_chunked(s, st, first)
+        self._chunk_rr = (rr + 1) % n
+        if advanced:
+            self._emit_pool_occupancy()
+        return advanced
+
+    def _fold_chunked(self, s: int, st: Dict[str, Any], first) -> None:
+        """The LAST chunk folded: its final-row logits are the first
+        token, the slot flips from prefilling to decoding, and TTFT
+        anchors here — mirroring the whole-prompt admission fold."""
+        rid = st["rid"]
+        t_done = self._clock()
+        self.lengths[s] = st["P"]
+        self.cur_tok[s, 0] = int(first[0])
+        self.remaining[s] = st["max_new"] - 1
+        self._tokens[rid] = [int(first[0])]
+        self._first_tok_t[rid] = t_done
+        del self._chunk_state[s]
+        for rl in self._reqlogs:
+            rl.first_token(rid, t_done)
+        sub_t = self._submit_t.pop(rid, None)
+        if sub_t is not None:
+            self.metrics.histogram("decode.ttft_s", unit="s").observe(
+                t_done - sub_t
+            )
+        if st["max_new"] == 1:  # the fold produced the only token
+            self._retire(s)
 
     # -- admission / retirement (between segments) -------------------------
     def _admit(self) -> int:
@@ -993,23 +1328,51 @@ class PagedDecodeEngine:
             if not free_slots:
                 break
             P = self._queue[0][1].shape[1]
+            if self.chunk_eligible(int(P)):
+                # long prompt: claim a slot + first-chunk pages only and
+                # prefill one chunk per segment (no whole-prompt wave)
+                if pages_needed(
+                    min(self.chunk_tokens, int(P)), self.page_size
+                ) > self.pool.free_pages:
+                    break  # backpressure: head waits for frees
+                self._admit_chunked(free_slots[0])
+                admitted += 1
+                continue
             h0 = 0
             if sharing:
                 h_max = (P - 1) // self.page_size
                 keys0 = prefix_chunk_keys(self._queue[0][1], self.page_size)
                 h0, _ = self.pool.match_prefix(keys0[:h_max])
             batch, hits, budget = [], [], self.pool.free_pages
+            seen_keys: set = set()
             for rid, ids, max_new in self._queue:
                 if ids.shape[1] != P or len(batch) >= len(free_slots):
                     break
+                if self.chunk_eligible(int(ids.shape[1])):
+                    break  # chunk-eligible twin of a short head: next wave
                 if sharing:
                     keys = prefix_chunk_keys(ids, self.page_size)
+                    kt = tuple(keys[:h_max])
+                    if kt and kt in seen_keys:
+                        # same-wave twin: defer it ONE wave so it aliases
+                        # the pages this wave is about to intern instead
+                        # of prefilling its own copies
+                        break
                     h, spages = self.pool.match_prefix(keys[:h_max])
                     if h != h0:
                         break
+                    # fresh tail pages, plus one free-list page per
+                    # matched page that is cached-free (revival draws
+                    # from the free list even though the page is matched)
+                    revive = sum(
+                        1 for p in spages if self.pool.is_cached(p)
+                    )
                     need = pages_needed(
                         ids.shape[1] + max_new, self.page_size
                     ) - h
+                    if need + revive > budget:
+                        break
+                    budget -= revive
                 else:
                     need = pages_needed(ids.shape[1] + max_new,
                                         self.page_size)
@@ -1018,6 +1381,8 @@ class PagedDecodeEngine:
                 budget -= need
                 batch.append((rid, ids, max_new, need))
                 if sharing:
+                    if kt:
+                        seen_keys.add(kt)
                     hits.append((spages, keys))
             if not batch:
                 break  # backpressure: head waits for frees
@@ -1045,9 +1410,21 @@ class PagedDecodeEngine:
                 if sharing:
                     spages, _keys = hits[j]
                     if spages:
+                        # share BEFORE alloc: a matched cached-free page
+                        # must be revived before alloc pressure can
+                        # evict its intern entry out from under us
                         self.pool.share(spages)
                     fresh = self.pool.alloc(need)
                     pages = list(spages) + fresh
+                    # intern every FULL prompt page NOW — before the
+                    # wave's prefill — so the NEXT wave of this _admit
+                    # call (a same-wave twin deferred by the seen_keys
+                    # break) aliases these pages instead of re-prefilling
+                    # (first writer wins; the prefill that writes the
+                    # content runs before any aliasing wave's stitched
+                    # gather reads it)
+                    for i in range(P // self.page_size):
+                        self.pool.register(int(pages[i]), _keys[i])
                     if h0 > 0:
                         wt_rows[j, :len(pages)] = (
                             [TRASH_PAGE] * h0 + fresh
@@ -1107,13 +1484,9 @@ class PagedDecodeEngine:
                 self._tokens[rid] = [int(first[j])]
                 self._first_tok_t[rid] = t_adm
                 if sharing:
-                    # intern every FULL prompt page (first writer wins)
-                    # so later arrivals with this prefix alias instead
-                    # of re-prefilling; the prefill physically wrote the
-                    # fresh pages, which the write witness records
-                    _spages, keys = hits[j]
-                    for i in range(P // self.page_size):
-                        self.pool.register(int(page_lists[j][i]), keys[i])
+                    # intern happened pre-prefill (same-wave aliasing);
+                    # the prefill physically wrote the fresh pages,
+                    # which the write witness records here
                     if self.ownlog is not None:
                         freshp = page_lists[j][h0:]
                         self.ownlog.record(
@@ -1206,6 +1579,11 @@ class PagedDecodeEngine:
         )
         if slot is None:
             raise ValueError(f"rid {rid!r} is not in flight")
+        if slot in self._chunk_state:
+            raise ValueError(
+                f"rid {rid!r} is mid-chunked-prefill and not preemptible "
+                "(no first token yet — there is no resumable prefix)"
+            )
         tokens = self._np.asarray(
             self._tokens.pop(rid), dtype=self._np.int32
         )
@@ -1235,12 +1613,35 @@ class PagedDecodeEngine:
 
     # -- the serving loop --------------------------------------------------
     def step_segment(self) -> int:
-        """Admit, run ONE K-step segment, fold tokens, retire finished
-        slots.  Returns the number of tokens delivered to requests."""
+        """Admit, advance pending prefill chunks (one chunk-token budget
+        per segment), run ONE K-step segment, fold tokens, retire
+        finished slots.  Returns the number of tokens delivered to
+        requests."""
+        # in-flight prefills advance BEFORE new admission so chunk
+        # slots claim their next pages first (admission would otherwise
+        # starve a mid-prefill long of pages every segment); a freshly
+        # chunk-admitted request then spends whatever prefill budget is
+        # left, so its first chunk still lands this segment
+        ct = self.chunk_tokens
+        full = (max(ct, self.slots * self.seg_steps)
+                if ct is not None else 0)
+        spent = self._advance_chunks() if self._chunk_state else 0
         self._admit()
+        if (ct is not None and spent < full and any(
+                st["next"] == 0 for st in self._chunk_state.values())):
+            self._advance_chunks(full - spent)
         owed = self.remaining.copy()
         if not owed.any():
-            return 0
+            # nothing to decode: the per-segment prefill throttle
+            # protects nobody, so drain pending chunks back-to-back
+            # until one folds into decodable work (or all stall on
+            # pages) — a lone long prompt prefills at full speed
+            while self._chunk_state and not self.remaining.any():
+                if not self._advance_chunks():
+                    break
+            owed = self.remaining.copy()
+            if not owed.any():
+                return 0
         self._ensure_exclusive()
         t_sg0 = self._clock()
         toks, self.pools = self._seg(
@@ -1274,7 +1675,9 @@ class PagedDecodeEngine:
                 delivered += n
                 for rl in self._reqlogs:
                     rl.deliver(rid, t_sg1, n)
-            if owed[s] <= self.seg_steps:
+            # owed == 0 means the slot is mid-chunk-prefill (occupied,
+            # decoding nothing yet) — it retires only after its fold
+            if 0 < owed[s] <= self.seg_steps:
                 self._retire(s)
         self.segments_run += 1
         self.metrics.counter("decode.segments_run").inc()
@@ -1287,13 +1690,24 @@ class PagedDecodeEngine:
     def run(self) -> Dict[Any, Any]:
         """Drain the queue and all active slots; returns {rid: np.int32
         tokens} (prompt excluded; exactly ``max_new_tokens`` each)."""
+        def _sig():
+            # progress signature: any admission, decode step, chunk
+            # advance, or retirement changes it.  Two identical
+            # consecutive signatures mean NOTHING can ever move again
+            # (the engine is deterministic between segments).
+            return (
+                len(self.results), len(self._queue),
+                int(self.lengths.sum()), int(self.remaining.sum()),
+                tuple(sorted(
+                    (s, st["next"])
+                    for s, st in self._chunk_state.items()
+                )),
+            )
+
         while self._queue or any(r is not None for r in self._slot_req):
-            before = len(self.results)
+            before = _sig()
             self.step_segment()
-            if (
-                len(self.results) == before
-                and not any(r is not None for r in self._slot_req)
-            ):
+            if _sig() == before:
                 raise RuntimeError(
                     "engine stalled: queued requests cannot be admitted "
                     f"({self.pool.free_pages} free pages)"

@@ -1312,6 +1312,7 @@ class DeviceBackend:
         memprof: Any = None,
         flight: Any = None,
         attention_impl: Optional[str] = None,
+        chunk_tokens: Optional[int] = None,
     ):
         """Continuous-batching paged decode engine over a SCHEDULED paged
         decode-step DAG (``frontend.build_paged_decode_dag``).
@@ -1337,6 +1338,7 @@ class DeviceBackend:
             slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
             tracer=trace, metrics=metrics, clock=clock, memprof=memprof,
             flight=flight, attention_impl=attention_impl,
+            chunk_tokens=chunk_tokens,
         )
 
     def execute(

@@ -1177,6 +1177,88 @@ def _paged_op_parity(kernel_impl: str, page_size: int = 16) -> Dict[str, Any]:
     return {"fixtures": out, "allclose": ok}
 
 
+def _ragged_op_parity_fixtures(page_size: int = 16) -> list:
+    """Multi-token-q (chunked prefill) fixtures for the ragged kernel
+    vs gather parity sweep: (name, S, Hq, Hkv, hd, ppseq, Tn,
+    [(base_len, q_len), ...]).  Each slot's chunk rows sit at absolute
+    positions ``base_len + t`` with causal masking; rows at or past
+    ``q_len`` are padding.  Covers the page-boundary straddle, a chunk
+    exactly one page long, a final partial chunk (q_len < Tn), an
+    idle slot (q_len == 0), and GQA head grouping — all against a
+    poisoned trash page, so masking is proven too."""
+    ps = page_size
+    return [
+        # chunk rows cross a physical page boundary mid-chunk
+        ("chunk_straddles_page", 2, 4, 2, 8, 3, 8,
+         [(ps - 3, 8), (ps + 5, 8)]),
+        # chunk length == page_size: rows fill page 2 exactly
+        ("chunk_eq_page", 2, 4, 2, 8, 3, ps, [(0, ps), (ps, ps)]),
+        # ragged tail: final chunk shorter than the padded grid
+        ("final_partial_chunk", 3, 4, 2, 8, 3, 8,
+         [(2 * ps, 3), (5, 1), (0, 8)]),
+        # a slot with no chunk this wave (q_len == 0) next to live ones
+        ("idle_slot", 2, 4, 2, 8, 2, 8, [(ps, 0), (3, 8)]),
+        # GQA: 4 query heads share each KV head across chunk rows
+        ("gqa_chunk", 2, 8, 2, 16, 2, 8, [(ps - 1, 8), (0, 5)]),
+    ]
+
+
+def _ragged_op_parity(
+    kernel_impl: str, page_size: int = 16
+) -> Dict[str, Any]:
+    """Op-level allclose sweep for the ragged multi-token-q path:
+    ``paged_decode_attention(..., q_lens=...)`` under ``kernel_impl``
+    vs the XLA gather path, chunk K/V pre-scattered into the pools
+    (write-then-attend at chunk granularity), trash page poisoned.
+    Padding rows (t >= q_lens[s]) are excluded from the comparison —
+    they are documented as finite-but-meaningless."""
+    import numpy as np
+
+    from ..models.kv_pages import TRASH_PAGE
+    from ..ops.attention import paged_decode_attention
+
+    rng = np.random.RandomState(5)
+    ps = page_size
+    out = {}
+    ok = True
+    for name, S, Hq, Hkv, hd, ppseq, Tn, spans in \
+            _ragged_op_parity_fixtures(ps):
+        n_pages = S * ppseq + 1
+        q = jnp.asarray(rng.randn(S, Hq, Tn, hd), jnp.float32)
+        k_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+        v_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+        k_pool = k_pool.at[TRASH_PAGE].set(1e9)
+        v_pool = v_pool.at[TRASH_PAGE].set(1e9)
+        pt = np.full((S, ppseq), TRASH_PAGE, np.int32)
+        page = 1
+        for s, (L, QL) in enumerate(spans):
+            # pages must cover the chunk's already-scattered K/V rows
+            for j in range((max(L + QL, 1) + ps - 1) // ps):
+                pt[s, j] = page
+                page += 1
+        pt = jnp.asarray(pt)
+        ln = jnp.asarray([L for L, _ in spans], jnp.int32)
+        ql = jnp.asarray([QL for _, QL in spans], jnp.int32)
+        ref = paged_decode_attention(
+            q, k_pool, v_pool, pt, ln, 1.0 / hd ** 0.5,
+            impl="xla", q_lens=ql,
+        )
+        got = paged_decode_attention(
+            q, k_pool, v_pool, pt, ln, 1.0 / hd ** 0.5,
+            impl=kernel_impl, q_lens=ql,
+        )
+        # compare REAL rows only: t < q_lens[s]
+        mask = (np.arange(Tn)[None, :] <
+                np.asarray(ql)[:, None]).astype(np.float32)
+        m4 = jnp.asarray(mask)[:, None, :, None]
+        err = float(jnp.max(jnp.abs((got - ref) * m4)))
+        close = bool(jnp.allclose(got * m4, ref * m4,
+                                  atol=1e-5, rtol=1e-5))
+        ok = ok and close
+        out[name] = {"max_abs_err": round(err, 9), "allclose": close}
+    return {"fixtures": out, "allclose": ok}
+
+
 def measure_paged_kernel(
     config=None,
     slots: int = 4,
@@ -1301,6 +1383,7 @@ def measure_paged_kernel(
     wall_k = sorted(walls_k)[len(walls_k) // 2]
 
     parity = _paged_op_parity(kernel_impl, page_size=page_size)
+    ragged = _ragged_op_parity(kernel_impl, page_size=page_size)
     res: Dict[str, Any] = {
         "platform": jax.default_backend(),
         "kernel_impl": kernel_impl,
@@ -1319,6 +1402,8 @@ def measure_paged_kernel(
         "pages_leaked_kernel": int(leaked_k),
         "parity": parity,
         "parity_ok": bool(parity["allclose"]),
+        "ragged_parity": ragged,
+        "ragged_parity_ok": bool(ragged["allclose"]),
     }
     if on_tpu:
         # wall-clock gate is only meaningful where the kernel lowers
@@ -1421,6 +1506,10 @@ if __name__ == "__main__":
             bad = [n for n, r in res["parity"]["fixtures"].items()
                    if not r["allclose"]]
             failures.append(f"op-level parity failed on {bad}")
+        if not res["ragged_parity_ok"]:
+            bad = [n for n, r in res["ragged_parity"]["fixtures"].items()
+                   if not r["allclose"]]
+            failures.append(f"ragged multi-token-q parity failed on {bad}")
         if res["pages_leaked_gather"] or res["pages_leaked_kernel"]:
             failures.append(
                 f"pages leaked (gather {res['pages_leaked_gather']}, "
@@ -1446,7 +1535,9 @@ if __name__ == "__main__":
         print(
             f"KERNEL GATES PASS: {res['kernel_impl']} tokens exact over "
             f"{res['n_requests']} requests, op parity across "
-            f"{len(res['parity']['fixtures'])} fixtures, zero leaks"
+            f"{len(res['parity']['fixtures'])} single-token + "
+            f"{len(res['ragged_parity']['fixtures'])} ragged fixtures, "
+            "zero leaks"
             + (f", {res['kernel_vs_gather_speedup']:.2f}x vs gather"
                if "kernel_vs_gather_speedup" in res else ""),
             file=sys.stderr,

@@ -43,6 +43,9 @@ LOWER_BETTER = (
     "serve.queue_wait_p95_ms",
     "serve.prefix.ttft_p99_ms",
     "serve.prefix.pages_leaked",
+    "serve.chunked.tpot_p99_ms",
+    "serve.chunked.ttft_p99_ms",
+    "serve.chunked.pages_leaked",
     # soak health slopes (dls.soak/1 artifact): clamped to >= 0, a
     # healthy run sits at or near 0 — any growth is a leak/degradation
     "soak.page_leak_slope_pages_s",
@@ -88,6 +91,14 @@ METRIC_DEFAULT_TOLERANCES = {
     "serve.prefix.goodput_gain": 0.0,
     "serve.prefix.shared_page_hits": 0.0,
     "serve.prefix.pages_leaked": 0.0,
+    # the chunked-prefill legs are the same VirtualClock determinism:
+    # both legs replay the identical seeded arrival stream, so tail
+    # latencies, the tpot gain ratio, and leak counts are exact
+    "serve.chunked.tpot_p99_ms": 0.0,
+    "serve.chunked.ttft_p99_ms": 0.0,
+    "serve.chunked.goodput_tok_s": 0.0,
+    "serve.chunked.tpot_p99_gain": 0.0,
+    "serve.chunked.pages_leaked": 0.0,
     # soak slopes share the serve bench's VirtualClock determinism: the
     # timestamps and token counts behind every Theil-Sen fit are pure
     # functions of the seed, so exact match is the right band even
@@ -116,6 +127,8 @@ HIGHER_BETTER = (
     "serve.prefix.goodput_tok_s",
     "serve.prefix.goodput_gain",
     "serve.prefix.shared_page_hits",
+    "serve.chunked.goodput_tok_s",
+    "serve.chunked.tpot_p99_gain",
     "soak.goodput_tok_s",
     "decode.paged_tok_s",
     "decode.paged_speedup",
@@ -126,6 +139,7 @@ HIGHER_BETTER = (
 )
 BOOL_METRICS = (
     "oracle_ok",
+    "serve.chunked.token_parity",
     "decode.paged_tokens_exact",
     "decode.kernel_tokens_exact",
     "decode.kernel_parity_ok",
@@ -156,6 +170,12 @@ DEFAULT_METRICS = (
     "serve.prefix.goodput_gain",
     "serve.prefix.shared_page_hits",
     "serve.prefix.pages_leaked",
+    "serve.chunked.tpot_p99_ms",
+    "serve.chunked.ttft_p99_ms",
+    "serve.chunked.goodput_tok_s",
+    "serve.chunked.tpot_p99_gain",
+    "serve.chunked.token_parity",
+    "serve.chunked.pages_leaked",
     "decode.paged_tokens_exact",
     "decode.pages_leaked",
     "decode.kernel_tokens_exact",

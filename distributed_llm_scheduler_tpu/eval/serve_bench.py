@@ -77,6 +77,33 @@ PREFIX_SCENARIO = {
 }
 
 
+#: the mixed-long-prompt interference scenario layered on the SCENARIO
+#: geometry: Poisson short-prompt traffic with a sparse very-long prompt
+#: every ``long_every``-th arrival.  ``prefill_tok_s`` makes prefill
+#: cost virtual time proportional to its REAL token count, so
+#: whole-prompt admission pays the long prompt in one bulge that every
+#: in-flight decode sees (the TPOT cliff), while chunked admission
+#: spreads the same total across segments — the comparison is pure
+#: scheduling, the total work charged is identical in both legs.
+#: Multi-segment short decodes (8–12 new tokens) keep pages occupied
+#: across segments, so the whole-prompt long's 4-page up-front claim
+#: blocks at the FIFO head while free slots idle behind it — the
+#: head-of-line stall chunked admission (first-chunk pages only)
+#: removes, which is where the p99 TTFT relief comes from
+CHUNKED_SCENARIO = {
+    "mlp_rate_rps": 8.0,
+    "mlp_n_requests": 26,
+    "short_lens": (5, 8),
+    "long_len": 24,
+    "long_every": 6,
+    "mlp_max_new_tokens": (8, 12),
+    "long_max_new_tokens": 4,
+    "chunk_tokens": 8,
+    "prefill_tok_s": 0.02,
+    "chunk_ttft_s": 10.0,
+}
+
+
 def build_serve_engine(
     slots: int = 4,
     page_size: int = 8,
@@ -88,6 +115,7 @@ def build_serve_engine(
     metrics: Any = None,
     attention_impl: Any = None,
     sharing: bool = False,
+    chunk_tokens: Any = None,
 ):
     """One tiny-GPT2 paged engine on the first CPU/TPU device, built
     through ``DeviceBackend.paged_decode_engine`` (pre-execution gate
@@ -126,7 +154,7 @@ def build_serve_engine(
         dag.graph, sched, cfg, weights, pool,
         slots=slots, pages_per_seq=pages_per_seq, seg_steps=seg_steps,
         clock=clock, flight=flight, metrics=metrics,
-        attention_impl=attention_impl,
+        attention_impl=attention_impl, chunk_tokens=chunk_tokens,
     )
     return eng, pool
 
@@ -174,11 +202,14 @@ def measure_serving(seed: int = 7,
                     scenario: Optional[Dict[str, Any]] = None,
                     engine: Optional[Any] = None,
                     prefix: bool = True,
+                    chunked: bool = True,
                     ) -> Dict[str, Any]:
     """The full comparison: fifo admit-all vs slo+preemption on the
     same arrival schedule, plus a same-seed determinism repeat of the
     slo leg, plus (``prefix=True``) the shared-prefix leg pair from
-    :func:`measure_prefix_sharing`.  Returns the ``dls.serve/1``
+    :func:`measure_prefix_sharing`, plus (``chunked=True``) the
+    mixed-long-prompt chunked-prefill leg pair from
+    :func:`measure_chunked_prefill`.  Returns the ``dls.serve/1``
     artifact dict.
 
     ``engine`` (test seam) reuses an already-compiled engine instead of
@@ -264,6 +295,20 @@ def measure_serving(seed: int = 7,
         art["serve.prefix.pages_leaked"] = (
             shared["pages_leaked"]
             + px["legs"]["unshared"]["pages_leaked"]
+        )
+    if chunked:
+        art["chunked"] = measure_chunked_prefill(
+            seed=seed, scenario=scenario, engine=eng
+        )
+        ck = art["chunked"]
+        cleg = ck["legs"]["chunked"]
+        art["serve.chunked.tpot_p99_ms"] = cleg["tpot_p99_ms"]
+        art["serve.chunked.ttft_p99_ms"] = cleg["ttft_p99_ms"]
+        art["serve.chunked.goodput_tok_s"] = cleg["goodput_tok_s"]
+        art["serve.chunked.tpot_p99_gain"] = ck["tpot_p99_gain"]
+        art["serve.chunked.token_parity"] = ck["token_parity"]
+        art["serve.chunked.pages_leaked"] = (
+            cleg["pages_leaked"] + ck["legs"]["whole"]["pages_leaked"]
         )
     return art
 
@@ -413,6 +458,165 @@ def measure_prefix_sharing(
     }
 
 
+def measure_chunked_prefill(
+    seed: int = 7,
+    scenario: Optional[Dict[str, Any]] = None,
+    engine: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """The mixed-long-prompt comparison: the SAME arrival schedule
+    served with whole-prompt admission (``chunk_tokens=None``) vs
+    chunked prefill, on one warmed engine (``engine.chunk_tokens`` is
+    toggled between reset legs and restored — with the prefill-time
+    hook cleared and a final reset — before returning).
+
+    Both legs run the identical :class:`ServiceTimeModel` with
+    ``prefill_tok_s > 0``: prefill costs virtual time where it actually
+    runs, so the whole leg's long-prompt bulge lands inside one segment
+    while the chunked leg amortizes it.  Every request's generated
+    tokens are kept per leg — the bitwise-parity gate compares them
+    directly — and a same-seed repeat of the chunked leg must digest
+    identically."""
+    from ..obs.slo import SLOPolicy
+    from ..serve.frontend import (
+        ServiceTimeModel,
+        ServingFrontend,
+        VirtualClock,
+    )
+    from ..serve.loadgen import mixed_long_prompt_arrivals, schedule_digest
+
+    sc = {**SCENARIO, **CHUNKED_SCENARIO, **(scenario or {})}
+    arrivals = mixed_long_prompt_arrivals(
+        sc["mlp_rate_rps"], sc["mlp_n_requests"], seed,
+        short_lens=sc["short_lens"], long_len=sc["long_len"],
+        long_every=sc["long_every"],
+        max_new_tokens=sc["mlp_max_new_tokens"],
+        long_max_new_tokens=sc["long_max_new_tokens"],
+    )
+    policy = SLOPolicy(
+        ttft_s=sc["chunk_ttft_s"], window_s=sc["window_s"],
+        percentile=sc["percentile"],
+    )
+    tm = ServiceTimeModel(
+        wave_s=sc["wave_s"], segment_s=sc["segment_s"],
+        idle_s=sc["idle_s"], prefill_tok_s=sc["prefill_tok_s"],
+    )
+    if engine is not None:
+        eng = engine
+    else:
+        eng, _pool = build_serve_engine(
+            slots=sc["slots"], page_size=sc["page_size"],
+            n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
+            seg_steps=sc["seg_steps"], clock=VirtualClock(),
+        )
+    prev_ct = eng.chunk_tokens
+    legs: Dict[str, Dict[str, Any]] = {}
+    tokens: Dict[str, Dict[str, List[int]]] = {}
+    chunk_counts: Dict[str, int] = {}
+
+    def _ctr(name: str) -> int:
+        return int(eng.metrics.counter(name).value)
+
+    try:
+        for name, ct in (("whole", None),
+                         ("chunked", sc["chunk_tokens"]),
+                         ("repeat", sc["chunk_tokens"])):
+            eng.reset()
+            eng._clock.reset()
+            eng.chunk_tokens = ct
+            adm0 = _ctr("decode.chunk_admitted")
+            fe = ServingFrontend(
+                eng, arrivals, policy, admission="slo",
+                preemption=False, time_model=tm,
+            )
+            leg = fe.run()
+            leg["digest"] = fe.digest()
+            legs[name] = leg
+            tokens[name] = {
+                rid: [int(t) for t in toks]
+                for rid, toks in fe.results.items()
+            }
+            chunk_counts[name] = _ctr("decode.chunk_admitted") - adm0
+    finally:
+        eng.chunk_tokens = prev_ct
+        eng.prefill_time_charge = None
+        eng.reset()
+    return {
+        "scenario": {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in sc.items()
+        },
+        "offered_load": {
+            "rate_rps": sc["mlp_rate_rps"],
+            "n_requests": len(arrivals),
+            "n_long": sum(
+                1 for a in arrivals if a.prompt_len == sc["long_len"]
+            ),
+            "arrival_span_s": arrivals[-1].t,
+            "schedule_digest": schedule_digest(arrivals),
+        },
+        "time_model": tm.to_json(),
+        "legs": {"whole": legs["whole"], "chunked": legs["chunked"]},
+        "deterministic": (
+            legs["chunked"]["digest"] == legs["repeat"]["digest"]
+        ),
+        "token_parity": tokens["whole"] == tokens["chunked"],
+        "chunk_admitted": chunk_counts["chunked"],
+        "whole_leg_chunk_admitted": chunk_counts["whole"],
+        "tpot_p99_gain": (
+            legs["whole"]["tpot_p99_ms"] / legs["chunked"]["tpot_p99_ms"]
+            if legs["chunked"]["tpot_p99_ms"] else None
+        ),
+    }
+
+
+def chunked_gate_failures(ck: Dict[str, Any]) -> List[str]:
+    """The r18 chunked-prefill gates: at equal offered load chunked
+    admission must strictly beat whole-prompt on p99 TPOT and be no
+    worse on p99 TTFT, deliver bitwise-identical tokens per request,
+    leak nothing on either leg, actually chunk at least one prompt, and
+    repeat digest-identically."""
+    failures: List[str] = []
+    whole = ck["legs"]["whole"]
+    chunked = ck["legs"]["chunked"]
+    if not chunked["tpot_p99_ms"] < whole["tpot_p99_ms"]:
+        failures.append(
+            f"chunked tpot p99 {chunked['tpot_p99_ms']:.1f} ms not "
+            f"strictly below whole-prompt {whole['tpot_p99_ms']:.1f} ms"
+        )
+    if not chunked["ttft_p99_ms"] <= whole["ttft_p99_ms"]:
+        failures.append(
+            f"chunked ttft p99 {chunked['ttft_p99_ms']:.1f} ms worse "
+            f"than whole-prompt {whole['ttft_p99_ms']:.1f} ms"
+        )
+    for name in ("whole", "chunked"):
+        leg = ck["legs"][name]
+        if leg["completed"] != leg["n_requests"]:
+            failures.append(
+                f"chunked-bench {name} leg completed {leg['completed']} "
+                f"of {leg['n_requests']} requests (parity needs all)"
+            )
+        if leg["pages_leaked"]:
+            failures.append(
+                f"chunked-bench {name} leg leaked "
+                f"{leg['pages_leaked']} pages"
+            )
+    if not ck["token_parity"]:
+        failures.append(
+            "chunked leg tokens differ from whole-prompt leg (bitwise)"
+        )
+    if ck["chunk_admitted"] < 1:
+        failures.append(
+            "chunked leg never chunk-admitted a prompt (mis-tuned)"
+        )
+    if ck["whole_leg_chunk_admitted"]:
+        failures.append("whole-prompt leg chunk-admitted a prompt")
+    if not ck["deterministic"]:
+        failures.append(
+            "chunked same-seed repeat diverged (digest mismatch)"
+        )
+    return failures
+
+
 def gate_failures(art: Dict[str, Any]) -> List[str]:
     """The acceptance gates, as human-readable failure strings."""
     failures: List[str] = []
@@ -431,6 +635,8 @@ def gate_failures(art: Dict[str, Any]) -> List[str]:
         failures.append("same-seed repeat diverged (digest mismatch)")
     if "prefix" in art:
         failures.extend(prefix_gate_failures(art["prefix"]))
+    if "chunked" in art:
+        failures.extend(chunked_gate_failures(art["chunked"]))
     return failures
 
 
@@ -516,6 +722,14 @@ _PREFIX_ACCT_REQUIRED = (
     "physical_pages_peak", "logical_pages_peak", "physical_pages_end",
     "logical_pages_end", "shared_page_hits",
 )
+#: required inside the (optional) top-level ``chunked`` block; when the
+#: block is present the flattened ``serve.chunked.*`` regression
+#: metrics must be present too
+_CHUNKED_REQUIRED = (
+    "scenario", "offered_load", "time_model", "legs", "deterministic",
+    "token_parity", "chunk_admitted", "whole_leg_chunk_admitted",
+    "tpot_p99_gain",
+)
 
 
 def validate_serve_artifact(art: Any) -> List[str]:
@@ -599,6 +813,41 @@ def validate_serve_artifact(art: Any) -> List[str]:
                 errs.append(f"missing top-level field {f!r}")
             elif not isinstance(art[f], (int, float)):
                 errs.append(f"{f} is not numeric")
+    if "chunked" in art:
+        ck = art["chunked"]
+        if not isinstance(ck, dict):
+            return errs + ["chunked block is not a dict"]
+        for f in _CHUNKED_REQUIRED:
+            if f not in ck:
+                errs.append(f"chunked missing {f!r}")
+        clegs = ck.get("legs")
+        if isinstance(clegs, dict):
+            for name in ("whole", "chunked"):
+                leg = clegs.get(name)
+                if not isinstance(leg, dict):
+                    errs.append(
+                        f"chunked.legs.{name} missing or not a dict"
+                    )
+                    continue
+                for f in _LEG_REQUIRED + ("tpot_p99_ms",):
+                    if f not in leg:
+                        errs.append(f"chunked.legs.{name} missing {f!r}")
+        else:
+            errs.append("chunked.legs block missing or not a dict")
+        for f in ("serve.chunked.tpot_p99_ms", "serve.chunked.ttft_p99_ms",
+                  "serve.chunked.goodput_tok_s",
+                  "serve.chunked.tpot_p99_gain",
+                  "serve.chunked.pages_leaked"):
+            if f not in art:
+                errs.append(f"missing top-level field {f!r}")
+            elif not isinstance(art[f], (int, float)):
+                errs.append(f"{f} is not numeric")
+        if "serve.chunked.token_parity" not in art:
+            errs.append(
+                "missing top-level field 'serve.chunked.token_parity'"
+            )
+        elif not isinstance(art["serve.chunked.token_parity"], bool):
+            errs.append("serve.chunked.token_parity is not a bool")
     return errs
 
 
@@ -620,6 +869,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="also write the dls.serve/1 artifact here")
     ap.add_argument("--no-prefix", action="store_true",
                     help="skip the shared-prefix leg pair")
+    ap.add_argument("--no-chunked", action="store_true",
+                    help="skip the mixed-long-prompt chunked leg pair")
     args = ap.parse_args(argv)
 
     overrides: Dict[str, Any] = {}
@@ -628,7 +879,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.n_requests is not None:
         overrides["n_requests"] = args.n_requests
     art = measure_serving(seed=args.seed, scenario=overrides or None,
-                          prefix=not args.no_prefix)
+                          prefix=not args.no_prefix,
+                          chunked=not args.no_chunked)
 
     def _strip(legs: Dict[str, Any]) -> Dict[str, Any]:
         return {
@@ -636,12 +888,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             for name, leg in legs.items()
         }
 
-    shown = {k: v for k, v in art.items() if k not in ("legs", "prefix")}
+    shown = {k: v for k, v in art.items()
+             if k not in ("legs", "prefix", "chunked")}
     shown["legs"] = _strip(art["legs"])
     if "prefix" in art:
         shown["prefix"] = (
             {k: v for k, v in art["prefix"].items() if k != "legs"}
             | {"legs": _strip(art["prefix"]["legs"])}
+        )
+    if "chunked" in art:
+        shown["chunked"] = (
+            {k: v for k, v in art["chunked"].items() if k != "legs"}
+            | {"legs": _strip(art["chunked"]["legs"])}
         )
     print(json.dumps(shown, indent=1, sort_keys=True))
     if args.out:
@@ -673,6 +931,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"(disabled), {px['accounting']['shared']['shared_page_hits']} "
             f"pages aliased, {px['cow_splits']} cow splits, page pass "
             "clean, 0 pages leaked, deterministic",
+            file=sys.stderr,
+        )
+    if "chunked" in art:
+        ck = art["chunked"]
+        cl = ck["legs"]["chunked"]
+        wl = ck["legs"]["whole"]
+        print(
+            f"CHUNKED GATES PASS: tpot p99 {cl['tpot_p99_ms']:.0f} ms "
+            f"(chunked) vs {wl['tpot_p99_ms']:.0f} ms (whole), ttft p99 "
+            f"{cl['ttft_p99_ms']:.0f} vs {wl['ttft_p99_ms']:.0f} ms, "
+            f"{ck['chunk_admitted']} prompts chunked, bitwise token "
+            "parity, 0 pages leaked, deterministic",
             file=sys.stderr,
         )
     return 0

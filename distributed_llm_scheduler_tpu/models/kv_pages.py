@@ -203,6 +203,13 @@ class PagePool:
     _refs: Dict[int, int] = field(default_factory=dict, repr=False)
     _intern: Dict[str, int] = field(default_factory=dict, repr=False)
     _page_key: Dict[int, str] = field(default_factory=dict, repr=False)
+    #: free pages whose intern entries are RETAINED (LRU cache of
+    #: last-released shared prefixes).  Insertion-ordered dict used as an
+    #: ordered set: insertion order == release order == eviction order.
+    #: Always a subset of ``_free`` — cached pages are physically free
+    #: (the books, the leak gauge, and the prover's tiling witness are
+    #: untouched); only the intern table keeps pointing at them.
+    _cached: Dict[int, None] = field(default_factory=dict, repr=False)
 
     def __post_init__(self) -> None:
         if self.n_pages < 2:
@@ -268,6 +275,17 @@ class PagePool:
     def refcount(self, page: int) -> int:
         return self._refs.get(int(page), 0)
 
+    @property
+    def cached_pages(self) -> int:
+        """Free pages whose prefix intern entries are retained (LRU)."""
+        return len(self._cached)
+
+    def is_cached(self, page: int) -> bool:
+        """True when ``page`` is physically free but its intern entry is
+        retained — a :meth:`match_prefix` hit on it costs one free-list
+        page to revive (admission counts it as physical demand)."""
+        return int(page) in self._cached
+
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
@@ -282,7 +300,28 @@ class PagePool:
                 f"page pool exhausted: want {n}, have {len(self._free)} "
                 f"free of {self.n_pages - 1} allocatable"
             )
-        pages = [self._free.pop() for _ in range(n)]
+        if not self._cached:
+            pages = [self._free.pop() for _ in range(n)]
+        else:
+            # lazy LRU eviction: serve uncached free pages first (LIFO,
+            # as before), and only under pressure evict cached prefixes,
+            # oldest release first — a popular prefix stays matchable
+            # until the allocator actually needs its page
+            pages = []
+            held: List[int] = []
+            while len(pages) < n and self._free:
+                p = self._free.pop()
+                if p in self._cached:
+                    held.append(p)
+                else:
+                    pages.append(p)
+            self._free.extend(reversed(held))
+            for p in list(self._cached):
+                if len(pages) >= n:
+                    break
+                self._evict_cached(p)
+                self._free.remove(p)
+                pages.append(p)
         self._allocated.update(pages)
         for p in pages:
             self._refs[p] = 1
@@ -315,14 +354,39 @@ class PagePool:
             self._allocated.discard(p)
             self._free.append(p)
             self._refs.pop(p, None)
-            key = self._page_key.pop(p, None)
-            if key is not None and self._intern.get(key) == p:
-                del self._intern[key]
+            if self.sharing and p in self._page_key:
+                # retain the intern entry: the page is physically free
+                # (books unchanged) but stays matchable until alloc
+                # pressure evicts it — LRU via _cached insertion order
+                self._cached[p] = None
+            else:
+                key = self._page_key.pop(p, None)
+                if key is not None and self._intern.get(key) == p:
+                    del self._intern[key]
         if self.ownlog is not None:
             self.ownlog.record(
                 "free", pages,
                 free_pages=len(self._free), used_pages=len(self._allocated),
             )
+
+    def _evict_cached(self, p: int) -> None:
+        """Drop a cached-free page's retained intern entry (the page
+        itself stays wherever the free-list caller put it)."""
+        del self._cached[p]
+        key = self._page_key.pop(p, None)
+        if key is not None and self._intern.get(key) == p:
+            del self._intern[key]
+
+    def drop_cached(self) -> int:
+        """Evict EVERY retained intern entry, returning how many were
+        dropped.  Engine reset must call this: reset reinitialises the
+        physical KV arrays, so a retained entry would point a future
+        :meth:`match_prefix` hit at zeroed storage — and a warm cache
+        across runs would also make same-seed repeats diverge."""
+        n = len(self._cached)
+        for p in list(self._cached):
+            self._evict_cached(p)
+        return n
 
     # -- prefix sharing ----------------------------------------------------
     def match_prefix(self, keys: Sequence[str]) -> Tuple[int, List[int]]:
@@ -342,22 +406,48 @@ class PagePool:
 
     def share(self, pages: Sequence[int]) -> None:
         """Take one additional reference on each page (aliasing commit).
-        Free/used counts are untouched — the ``share`` event carries
-        them so the prover's physical tiling witness extends across
-        sharing traffic."""
+
+        A RESIDENT page bumps its refcount; free/used counts are
+        untouched and the ``share`` event carries them so the prover's
+        physical tiling witness extends across sharing traffic.  A
+        CACHED-FREE page (retained intern entry, see :meth:`free`) is
+        REVIVED instead: it leaves the free list with refcount 1 and is
+        recorded as a plain ``alloc`` — to the prover a revival is
+        indistinguishable from a fresh allocation, which is exactly the
+        physical truth.  Callers must share matched pages BEFORE
+        allocating fresh ones, or alloc pressure may evict the match out
+        from under them."""
         if not self.sharing:
             raise ValueError("share() on a pool with sharing disabled")
-        pages = list(pages)
+        revived: List[int] = []
+        bumped: List[int] = []
         for p in pages:
-            if p not in self._allocated:
+            p = int(p)
+            if p in self._cached:
+                del self._cached[p]
+                self._free.remove(p)
+                self._allocated.add(p)
+                self._refs[p] = 1
+                revived.append(p)
+            elif p in self._allocated:
+                self._refs[p] = self._refs.get(p, 0) + 1
+                bumped.append(p)
+            else:
                 raise ValueError(f"share of unallocated page {p}")
-            self._refs[p] = self._refs.get(p, 0) + 1
         if self.ownlog is not None:
-            self.ownlog.record(
-                "share", pages,
-                free_pages=len(self._free), used_pages=len(self._allocated),
-                refcounts=[self._refs[p] for p in pages],
-            )
+            if revived:
+                self.ownlog.record(
+                    "alloc", revived,
+                    free_pages=len(self._free),
+                    used_pages=len(self._allocated),
+                )
+            if bumped:
+                self.ownlog.record(
+                    "share", bumped,
+                    free_pages=len(self._free),
+                    used_pages=len(self._allocated),
+                    refcounts=[self._refs[p] for p in bumped],
+                )
 
     def register(self, page: int, key: str) -> None:
         """Intern ``page`` under chain-hash ``key`` (first writer wins —

@@ -231,6 +231,7 @@ def paged_kernel_constraints(
     n_kv_heads: int,
     n_q_heads: Optional[int] = None,
     dtype: Any = jnp.float32,
+    q_tokens: Optional[int] = None,
 ) -> list:
     """Violated tiling/layout constraints for the COMPILED ragged paged
     kernel — empty list means the geometry is kernel-eligible.
@@ -263,6 +264,15 @@ def paged_kernel_constraints(
             f"n_q_heads {n_q_heads} is not a multiple of n_kv_heads "
             f"{n_kv_heads} (GQA group mapping)"
         )
+    if q_tokens is not None:
+        if q_tokens < 1:
+            out.append(f"q_tokens {q_tokens} must be >= 1")
+        elif q_tokens > 1 and q_tokens % sublane:
+            out.append(
+                f"q_tokens {q_tokens} is not a multiple of the "
+                f"{sublane}-row sublane tile of the ragged multi-token "
+                "query block"
+            )
     return out
 
 
@@ -271,21 +281,24 @@ def paged_pallas_supported(
 ) -> bool:
     """Eligibility of the ragged paged kernel for this call.
 
-    Structural preconditions (every mode): single-token query, query heads
-    an exact multiple of KV heads, matching head_dim.  Compiled mode
-    additionally requires the :func:`paged_kernel_constraints` tiling
-    rules; interpret mode (CPU parity tests) has no tiling constraints.
+    Structural preconditions (every mode): query heads an exact multiple
+    of KV heads, matching head_dim, at least one query token (Tn == 1 is
+    the decode step; Tn > 1 is a ragged prefill chunk with per-slot
+    ``q_lens``).  Compiled mode additionally requires the
+    :func:`paged_kernel_constraints` tiling rules; interpret mode (CPU
+    parity tests) has no tiling constraints.
     """
     S, Hq, Tn, hd = q_shape
     n_pages, page_size, Hkv, pool_hd = pool_shape
-    if Tn != 1 or Hkv < 1 or Hq % Hkv or hd != pool_hd:
+    if Tn < 1 or Hkv < 1 or Hq % Hkv or hd != pool_hd:
         return False
     if not _HAS_PLTPU:  # PrefetchScalarGridSpec lives in pltpu
         return False
     if interpret:
         return True
     return not paged_kernel_constraints(
-        page_size, hd, Hkv, n_q_heads=Hq
+        page_size, hd, Hkv, n_q_heads=Hq,
+        q_tokens=Tn if Tn > 1 else None,
     )
 
 
@@ -458,6 +471,179 @@ def _paged_flash(
     return out.reshape(S, Hq, 1, hd)
 
 
+def _paged_ragged_kernel(
+    pt_ref, len_ref, ql_ref, q_ref, k_ref, v_ref, o_ref,
+    acc_ref, m_ref, l_ref, *, sm_scale, page_size, groups, q_tokens,
+):
+    """One (slot, logical page) grid step of the ragged MULTI-token-q
+    paged kernel — the prefill-chunk shape of :func:`_paged_kernel`.
+
+    The query block carries ``q_tokens`` rows per slot; per-slot
+    ``q_lens`` rides scalar prefetch next to the page table and lengths.
+    Query row ``t`` of slot ``s`` sits at absolute position
+    ``lengths[s] + t`` and attends KV positions ``<= lengths[s] + t``
+    (causal within the chunk, full history before it) — write-then-
+    attend: the chunk's own K/V rows are already scattered into the
+    pool.  Rows at or past ``q_lens[s]`` are padding; their mask is
+    clamped to the last real row so every output row stays finite and
+    trash-page-invariant (the caller discards them).  The online-softmax
+    carry is the single-token kernel's with the (groups) axis widened to
+    (groups * q_tokens).
+    """
+    s_idx = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    L = len_ref[s_idx]
+    QL = ql_ref[s_idx]
+    hd = q_ref.shape[-1]
+    Hkv = k_ref.shape[2]
+    # (Hq, Tn, hd) -> (Hkv, G*Tn, hd): adjacent-axis merge, column
+    # c = g*q_tokens + t, so t recovers as c % q_tokens
+    q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(
+        Hkv, groups * q_tokens, hd
+    )
+    k = k_ref[0].astype(jnp.float32)  # (page_size, Hkv, hd)
+    v = v_ref[0].astype(jnp.float32)
+    # scores (Hkv, page_size, G*Tn): K @ q, the gather path's orientation
+    s = jax.lax.dot_general(
+        k, q, (((2,), (2,)), ((1,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    pos = (
+        jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page_size
+    )
+    t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) % q_tokens
+    t_eff = jnp.clip(t, 0, jnp.maximum(QL - 1, 0))
+    s = jnp.where(pos <= L + t_eff, s, _NEG_INF)
+    # position 0 is unmasked for every row (L + t_eff >= 0), so the
+    # running max turns finite at page 0 and the exp() args stay finite
+    m_prev = m_ref[...]                       # (Hkv, G*Tn)
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None, :])        # (Hkv, page_size, G*Tn)
+    l_ref[...] = l_ref[...] * alpha + p.sum(axis=1)
+    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )  # (Hkv, G*Tn, hd)
+    m_ref[...] = m_new
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        out = acc_ref[...] / l_ref[...][:, :, None]
+        o_ref[0] = out.reshape(
+            Hkv * groups, q_tokens, hd
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_flash_ragged(
+    q, k_pool, v_pool, page_table, lengths, q_lens, *,
+    sm_scale, interpret,
+):
+    """Fused ragged multi-token-q paged attention (prefill chunks).
+
+    Same (slots, pages_per_seq) grid and page-table-directed block loads
+    as :func:`_paged_flash`, with a (1, Hq, Tn, hd) query block per slot
+    and per-slot ``q_lens`` as a third scalar-prefetch operand.  No
+    in-kernel insert: chunk K/V rows are scattered into the pool before
+    the call (write-then-attend at chunk granularity).
+    """
+    S, Hq, Tn, hd = q.shape
+    _, page_size, Hkv, _ = k_pool.shape
+    G = Hq // Hkv
+    ppseq = page_table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, ppseq),
+        in_specs=[
+            pl.BlockSpec(
+                (1, Hq, Tn, hd), lambda s, j, pt, ln, ql: (s, 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, page_size, Hkv, hd),
+                lambda s, j, pt, ln, ql: (pt[s, j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, Hkv, hd),
+                lambda s, j, pt, ln, ql: (pt[s, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Hq, Tn, hd), lambda s, j, pt, ln, ql: (s, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G * Tn, hd), jnp.float32),
+            pltpu.VMEM((Hkv, G * Tn), jnp.float32),
+            pltpu.VMEM((Hkv, G * Tn), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _paged_ragged_kernel, sm_scale=sm_scale,
+            page_size=page_size, groups=G, q_tokens=Tn,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Hq, Tn, hd), q.dtype),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+        q_lens.astype(jnp.int32), q, k_pool, v_pool,
+    )
+
+
+def _gather_chunk_attention(
+    q, k_pool, v_pool, page_table, lengths, q_lens, scale
+):
+    """XLA gather path for ragged multi-token q — the op-level parity
+    reference for :func:`_paged_flash_ragged`.
+
+    Identical orientation and masking to the single-token gather path
+    with the (G) column axis widened to (G*Tn) and the length mask
+    shifted per query row: row ``t`` attends positions ``<=
+    lengths[s] + t`` (padding rows clamp to the last real row, matching
+    the kernel).  Chunk rows must already be resident in the pools.
+    """
+    from ..models.kv_pages import gather_kv_flat  # lazy: models imports ops
+
+    S, Hq, Tn, hd = q.shape
+    k_view = gather_kv_flat(k_pool, page_table)  # (S, M, Hkv, hd)
+    v_view = gather_kv_flat(v_pool, page_table)
+    Hkv = k_view.shape[2]
+    G = Hq // Hkv
+    qg = (q * scale).reshape(S, Hkv, G * Tn, hd)
+    s = jax.lax.dot_general(
+        k_view.astype(qg.dtype), qg,
+        (((3,), (3,)), ((0, 2), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )  # (S, Hkv, M, G*Tn)
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3) % Tn
+    ql = q_lens.reshape(S, 1, 1, 1).astype(jnp.int32)
+    t_eff = jnp.clip(t, 0, jnp.maximum(ql - 1, 0))
+    valid = rows <= lengths.reshape(S, 1, 1, 1) + t_eff
+    s = jnp.where(valid, s, jnp.finfo(s.dtype).min)
+    m = s.max(axis=2, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=2, keepdims=True)
+    out_dtype = q.dtype
+    o = jax.lax.dot_general(
+        p.astype(out_dtype), v_view.astype(out_dtype),
+        (((2,), (1,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.float32,
+    )  # (S, Hkv, G*Tn, hd)
+    return (o / l.reshape(S, Hkv, G * Tn, 1)).astype(out_dtype).reshape(
+        S, Hq, Tn, hd
+    )
+
+
 def paged_decode_attention(
     q: jax.Array,
     k_pool: jax.Array,
@@ -468,6 +654,7 @@ def paged_decode_attention(
     k_new: Optional[jax.Array] = None,
     v_new: Optional[jax.Array] = None,
     impl: Optional[str] = None,
+    q_lens: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ragged paged single-token attention: gather-by-page-table,
     per-sequence length-masked, static shapes throughout.
@@ -504,10 +691,43 @@ def paged_decode_attention(
     allclose — not bitwise — to the gather path (page-blocked online
     softmax associates its reductions differently), which keeps greedy
     argmax tokens identical at engine scale (pinned by the parity gate).
+
+    ``q`` with Tn > 1 is a ragged prefill chunk: per-slot ``q_lens``
+    (S,) int32 gives the number of REAL query rows (rows past it are
+    padding, returned finite but meaningless), query row ``t`` of slot
+    ``s`` sits at absolute position ``lengths[s] + t`` and attends
+    causally, and the chunk's K/V rows must already be scattered into
+    the pools (``k_new`` is not accepted — write-then-attend is at
+    chunk granularity, not per-row).
     """
     S, Hq, Tn, hd = q.shape
     if Tn != 1:
-        raise ValueError(f"paged decode attention is single-token, Tn={Tn}")
+        if q_lens is None:
+            raise ValueError(
+                f"multi-token q (Tn={Tn}) requires per-slot q_lens"
+            )
+        if k_new is not None:
+            raise ValueError(
+                "multi-token q takes no k_new/v_new: scatter the chunk "
+                "into the pools first (write-then-attend at chunk "
+                "granularity)"
+            )
+        impl = resolve_attention_impl(
+            impl,
+            lambda i: paged_pallas_supported(
+                q.shape, k_pool.shape, interpret=(i == "pallas_interpret")
+            ),
+        )
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+        if impl in ("pallas", "pallas_interpret"):
+            return _paged_flash_ragged(
+                q, k_pool, v_pool, page_table, lengths, q_lens,
+                sm_scale=float(scale),
+                interpret=impl == "pallas_interpret",
+            )
+        return _gather_chunk_attention(
+            q, k_pool, v_pool, page_table, lengths, q_lens, scale
+        )
     impl = resolve_attention_impl(
         impl,
         lambda i: paged_pallas_supported(

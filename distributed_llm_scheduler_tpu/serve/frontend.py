@@ -91,10 +91,15 @@ class ServiceTimeModel:
     wave_s: float = 0.01
     segment_s: float = 0.05
     idle_s: float = 0.005
+    #: per-prompt-token prefill cost, charged at each prefill dispatch
+    #: (whole-prompt waves pay it in one bulge; chunked admission spreads
+    #: it across segments — the interference the chunked bench measures)
+    prefill_tok_s: float = 0.0
 
     def to_json(self) -> Dict[str, float]:
         return {"wave_s": self.wave_s, "segment_s": self.segment_s,
-                "idle_s": self.idle_s}
+                "idle_s": self.idle_s,
+                "prefill_tok_s": self.prefill_tok_s}
 
 
 class _Req:
@@ -177,6 +182,14 @@ class ServingFrontend:
                 "a ServiceTimeModel needs a VirtualClock on the engine"
             )
         self.tm = time_model or ServiceTimeModel()
+        if (self._virtual and self.tm.prefill_tok_s > 0
+                and hasattr(engine, "prefill_time_charge")):
+            # charge prefill by REAL token count at each dispatch: the
+            # engine calls back before every prefill (whole, shared or
+            # chunk), so long prompts cost virtual time where they run
+            engine.prefill_time_charge = (
+                lambda n: self.clock.advance(self.tm.prefill_tok_s * n)
+            )
         # injectable idle sleep (real-clock mode only): tests script a
         # fake clock + recording sleep to cover the wall-clock path
         # without spending wall time
@@ -366,7 +379,16 @@ class ServingFrontend:
         for req in order:
             if breaching and req.a.priority > 0 and not req.passes:
                 continue  # defer low tier while the TTFT window breaches
-            if sharing:
+            adm_need = getattr(
+                self.engine, "admission_pages_needed", None
+            )
+            if adm_need is not None:
+                # the engine's own headroom arithmetic: first-chunk-only
+                # for chunk-eligible prompts (later chunks alloc lazily),
+                # fresh-tail footprint under sharing, full footprint
+                # otherwise
+                need = adm_need(req.cur_prompt, req.cur_max_new)
+            elif sharing:
                 # fresh-tail footprint only: resident shared prefix
                 # chunks cost no new pages, so admission sees the same
                 # headroom the engine's allocator will
@@ -402,9 +424,14 @@ class ServingFrontend:
         # pages (aliased prefix chunks stay resident for their other
         # owners) — the conservative count keeps the estimate honest
         per_req = occ.get("per_request_exclusive", occ["per_request"])
+        prefilling = getattr(self.engine, "is_prefilling", None)
         victims = [
             v for v in self._inflight.values()
             if v.a.priority > req.a.priority and v.passes
+            # mid-chunked-prefill slots are not preemptible: no first
+            # token yet means no resumable prefix, only wasted chunks
+            and not (prefilling is not None
+                     and prefilling(v.engine_rid()))
         ]
         # most recently arrived, lowest tier first: evict the work with
         # the least sunk queue-wait
@@ -581,6 +608,8 @@ class ServingFrontend:
             "queue_wait_p50_ms": qwait["p50"],
             "queue_wait_p95_ms": qwait["p95"],
             "tpot_p50_ms": tpot["p50"],
+            "tpot_p95_ms": tpot["p95"],
+            "tpot_p99_ms": tpot["p99"],
             "pages_leaked": occ["n_pages"] - occ["free_pages"],
             "breached": breached,
             "slo": slo_summary,

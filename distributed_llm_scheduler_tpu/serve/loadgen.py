@@ -172,6 +172,75 @@ def session_arrivals(
     return out
 
 
+def mixed_long_prompt_arrivals(
+    rate_rps: float,
+    n_requests: int,
+    seed: int,
+    *,
+    short_lens: Sequence[int] = (3, 5, 8),
+    long_len: int = 24,
+    long_every: int = 8,
+    max_new_tokens: Sequence[int] = (3,),
+    long_max_new_tokens: int = 4,
+    priorities: Sequence[int] = (0,),
+    priority_weights: Optional[Sequence[float]] = None,
+    rid_prefix: str = "m",
+) -> List[Arrival]:
+    """Poisson short-prompt traffic with sparse very-long prompts: the
+    interference shape where whole-prompt admission cliffs (one long
+    prefill stalls every in-flight decode) and chunked prefill pays off.
+
+    Every ``long_every``-th arrival (1-indexed: arrivals ``long_every``,
+    ``2*long_every``, ...) is a ``long_len``-token prompt with its own
+    decode budget; the rest draw from ``short_lens``.  The long cadence
+    is deterministic by POSITION, not by draw, so the long/short
+    interleaving is identical across seeds that only reshuffle the
+    short-prompt draws.  Plain :class:`Arrival` rows — the same
+    ``dls.arrivals/1`` trace round-trip, digest, and replay machinery
+    applies unchanged.
+    """
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    if long_every < 2:
+        raise ValueError(f"long_every must be >= 2, got {long_every}")
+    if long_len <= max(short_lens):
+        raise ValueError(
+            f"long_len {long_len} must exceed the longest short prompt "
+            f"{max(short_lens)}"
+        )
+    rng = np.random.RandomState(seed)
+    p = None
+    if priority_weights is not None:
+        if len(priority_weights) != len(priorities):
+            raise ValueError(
+                f"{len(priority_weights)} weights for "
+                f"{len(priorities)} priorities"
+            )
+        total = float(sum(priority_weights))
+        p = [w / total for w in priority_weights]
+    out: List[Arrival] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate_rps))
+        # draw unconditionally so the short-prompt stream is identical
+        # whether or not this position is a long one
+        plen = int(rng.choice(list(short_lens)))
+        mnew = int(rng.choice(list(max_new_tokens)))
+        prio = int(rng.choice(list(priorities), p=p))
+        if (i + 1) % long_every == 0:
+            plen, mnew = long_len, long_max_new_tokens
+        out.append(Arrival(
+            rid=f"{rid_prefix}{i}",
+            t=t,
+            prompt_len=plen,
+            max_new_tokens=mnew,
+            priority=prio,
+        ))
+    return out
+
+
 def session_prompt_token_ids(
     rid: Any,
     prompt_len: int,
@@ -315,6 +384,7 @@ __all__ = [
     "TRACE_SCHEMA",
     "arrivals_to_json",
     "load_trace",
+    "mixed_long_prompt_arrivals",
     "poisson_arrivals",
     "prompt_token_ids",
     "save_trace",

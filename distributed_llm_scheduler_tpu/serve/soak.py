@@ -77,6 +77,10 @@ class SoakConfig:
     real_clock: bool = False
     #: paged attention impl baked into the engine's DAG (None = op auto)
     attention_impl: Optional[str] = None
+    #: chunked-prefill chunk size (None = whole-prompt admission); the
+    #: soak arrival mix is short prompts, so this mostly exercises the
+    #: chunk scheduler's steady-state accounting under sustained load
+    chunk_tokens: Optional[int] = None
 
     def validate(self) -> None:
         """Raises ``ValueError`` on a malformed config (CLI exit 2)."""
@@ -103,6 +107,10 @@ class SoakConfig:
             from ..ops.attention import resolve_attention_impl
 
             resolve_attention_impl(self.attention_impl, lambda _i: True)
+        if self.chunk_tokens is not None and self.chunk_tokens < 1:
+            raise ValueError(
+                f"chunk_tokens must be >= 1, got {self.chunk_tokens}"
+            )
 
 
 # -- test-only fault injectors ---------------------------------------------
@@ -241,6 +249,7 @@ def run_soak(
         eng = engine_factory(
             clock=clock, flight=flight, attention_impl=cfg.attention_impl
         )
+        eng.chunk_tokens = cfg.chunk_tokens
     else:
         eng, _pool = build_serve_engine(
             slots=SCENARIO["slots"], page_size=SCENARIO["page_size"],
@@ -248,6 +257,7 @@ def run_soak(
             pages_per_seq=SCENARIO["pages_per_seq"],
             seg_steps=SCENARIO["seg_steps"], clock=clock, flight=flight,
             attention_impl=cfg.attention_impl,
+            chunk_tokens=cfg.chunk_tokens,
         )
     injection: Dict[str, Any] = {}
     if inject_leak_every is not None:

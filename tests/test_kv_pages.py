@@ -136,9 +136,70 @@ def test_match_share_release_roundtrip():
     pool.release_ref(pages)  # drop the alias: nothing freed physically
     assert pool.used_pages == 2 and pool.refcount(pages[0]) == 1
     assert pool.match_prefix(keys) == (2, pages)  # still interned
-    pool.release_ref(pages)  # last reference frees + evicts the intern
+    # last reference frees the pages PHYSICALLY but retains the intern
+    # entries (LRU): the prefix stays matchable until alloc pressure or
+    # an explicit drop evicts it
+    pool.release_ref(pages)
     assert pool.free_pages == 7
+    assert pool.match_prefix(keys) == (2, pages)
+    assert pool.cached_pages == 2
+    assert pool.is_cached(pages[0]) and pool.is_cached(pages[1])
+    assert pool.drop_cached() == 2
+    assert pool.cached_pages == 0
     assert pool.match_prefix(keys) == (0, [])
+
+
+def test_lru_retention_alloc_prefers_uncached_then_evicts_oldest():
+    """Cached-free pages are the allocator's LAST resort, and eviction
+    under pressure is oldest-release-first (LRU)."""
+    pool = PagePool(n_pages=6, page_size=4, sharing=True)
+    a = pool.alloc(2)      # pages for prefix A
+    b = pool.alloc(2)      # pages for prefix B
+    ka = prefix_chunk_keys(list(range(8)), 4)
+    kb = prefix_chunk_keys(list(range(100, 108)), 4)
+    for p, k in zip(a, ka):
+        pool.register(p, k)
+    for p, k in zip(b, kb):
+        pool.register(p, k)
+    pool.free(a)           # A released first -> oldest cached
+    pool.free(b)
+    assert pool.free_pages == 5 and pool.cached_pages == 4
+    # one uncached free page exists; a 1-page alloc must take IT and
+    # leave both prefixes matchable
+    c = pool.alloc(1)
+    assert pool.cached_pages == 4
+    assert pool.match_prefix(ka)[0] == 2
+    assert pool.match_prefix(kb)[0] == 2
+    # pressure: the next alloc must evict from A (older) before B
+    d = pool.alloc(2)
+    assert pool.match_prefix(ka)[0] == 0, "oldest prefix must evict first"
+    assert pool.match_prefix(kb)[0] == 2
+    pool.free(c)
+    pool.free(d)
+
+
+def test_share_revives_cached_free_pages_as_alloc():
+    """A match on a cached-free page revives it: ``share`` re-allocates
+    it off the free list (an 'alloc' event, not a 'share' — the page had
+    no live reference to add to) and the books balance."""
+    log = PageOwnershipLog(n_pages=8)
+    pool = PagePool(n_pages=8, page_size=4, sharing=True, ownlog=log)
+    keys = prefix_chunk_keys(list(range(8)), 4)
+    pages = pool.alloc(2)
+    for p, k in zip(pages, keys):
+        pool.register(p, k)
+    pool.free(pages)       # retained: physically free, still matchable
+    h, matched = pool.match_prefix(keys)
+    assert (h, matched) == (2, pages)
+    before = pool.free_pages
+    pool.share(matched)    # revival: consumes the free-list entries
+    assert pool.free_pages == before - 2
+    assert pool.refcount(pages[0]) == 1 and not pool.is_cached(pages[0])
+    kinds = [e["kind"] for e in log.snapshot()["events"]]
+    assert kinds[-1] == "alloc", "revival must book as an allocation"
+    pool.release_ref(pages)
+    assert pool.free_pages == before  # and back to retained-free
+    assert pool.cached_pages == 2
 
 
 def test_sharing_disabled_pool_is_inert():
@@ -334,33 +395,23 @@ def test_paged_loop_rejects_multi_node_placement():
         compose_paged_step_fn(dag.graph, sched, GPT2Config.tiny())
 
 
-def test_continuous_batching_token_exact_under_churn():
+def test_continuous_batching_token_exact_under_churn(session_slo_engine):
     """More requests than slots, mixed prompt/gen lengths, so slots
     retire and readmit mid-run: every request's tokens must equal the
     whole-program greedy ``generate`` stream, and every page must come
-    back to the pool."""
-    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
-    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
-        build_paged_decode_dag,
-    )
+    back to the pool.  Rides the session-scoped engine (same tiny
+    geometry) instead of paying its own DAG build + XLA compile; the
+    ``generate`` reference runs off ``eng.weights`` — the exact arrays
+    the engine decodes with — so token parity is still end-to-end."""
     from distributed_llm_scheduler_tpu.models import gpt2
 
     cfg = gpt2.GPT2Config.tiny()
-    slots, ps, n_pages, ppseq = 2, 8, 32, 4
-    cap = ps * ppseq
-    dag = build_paged_decode_dag(cfg, slots=slots, page_size=ps,
-                                 n_pages=n_pages, pages_per_seq=ppseq)
-    params = dag.init_params()
-    weights = {k: v for k, v in params.items()
-               if not (k.startswith("cache_") or k == "page_table")}
-    cluster = Cluster.from_jax_devices(jax.devices()[:1])
-    backend = DeviceBackend(cluster)
-    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    pool = PagePool(n_pages=n_pages, page_size=ps)
-    eng = backend.paged_decode_engine(
-        dag.graph, sched, cfg, weights, pool,
-        slots=slots, pages_per_seq=ppseq, seg_steps=4,
-    )
+    eng = session_slo_engine
+    eng.rebind_obs()  # pristine pool + run state, warm executables
+    pool = eng.pool
+    n_pages = pool.n_pages
+    cap = eng.page_size * eng.pages_per_seq
+    params = eng.weights
 
     rng = np.random.RandomState(3)
     reqs = []
@@ -422,8 +473,9 @@ def test_shared_prefix_churn_property(session_slo_engine):
     shared-prefix request mix: after EVERY action the pool must tile
     physically (free + unique used == allocatable), refcounts must
     cover every slot-held page, the intern table must only point at
-    live pages, and the ownership stream must replay clean through the
-    page-lifetime prover.  At the end: zero physical leaks, a clean
+    live pages or retained cached-free ones (LRU retention), and the
+    ownership stream must replay clean through the page-lifetime
+    prover.  At the end: zero physical leaks, a clean
     final prover pass (orphan scan included), and bitwise-identical
     tokens for two concurrently-decoded requests aliasing the same
     prefix pages."""
@@ -459,7 +511,8 @@ def test_shared_prefix_churn_property(session_slo_engine):
                 for p in eng._slot_pages[s]:
                     assert pool.refcount(p) >= 1
             for key, page in pool._intern.items():
-                assert page in pool._allocated
+                # live, or physically free with its entry retained
+                assert page in pool._allocated or pool.is_cached(page)
                 assert pool._page_key.get(page) == key
             rep = analyze_pages(log, final=False)  # mid-run: no orphan scan
             assert [d.code for d in rep.diagnostics] == []
@@ -502,9 +555,10 @@ def test_shared_prefix_churn_property(session_slo_engine):
         assert occ["free_pages"] == occ["n_pages"], "pages leaked"
 
         # epilogue: a second identical prompt arriving one segment later
-        # must alias the first's freshly-interned pages (same-wave twins
-        # would both miss — nothing is interned when the batch forms)
-        # and decode to bitwise-identical token streams
+        # must alias the first's freshly-interned pages and decode to
+        # bitwise-identical token streams.  (Same-wave twins also share
+        # now: _admit defers duplicate prefixes by one wave so the first
+        # copy's pages are interned before the twin scatters.)
         twin = prompt_for(1)  # 24 tokens -> 2 shareable full pages
         n_share = sum(1 for e in log.events if e["kind"] == "share")
         # budget > seg_steps so za is still resident when zb arrives
@@ -522,5 +576,50 @@ def test_shared_prefix_churn_property(session_slo_engine):
         assert [d.code for d in analyze_pages(log).diagnostics] == []
     finally:
         eng.pool.sharing = False  # next rebind builds a non-sharing pool
+        eng.attach_ownership_log(None)
+        eng.reset()
+
+
+def test_same_wave_twins_share_prefix_pages(session_slo_engine):
+    """Two identical prompts submitted into the SAME admission wave
+    must still alias prefix pages: ``_admit`` defers the duplicate by
+    one wave so the first copy's pages are interned before the twin
+    scatters.  Tokens stay bitwise identical to a no-sharing baseline,
+    the ownership log shows share events with no CoW, and nothing
+    leaks."""
+    from distributed_llm_scheduler_tpu.analysis.page_pass import (
+        analyze_pages,
+    )
+
+    eng = session_slo_engine
+    log = PageOwnershipLog(n_pages=eng.pool.n_pages)
+    try:
+        rng = np.random.RandomState(5)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.randint(1, 40, size=16)]], jnp.int32
+        )  # 16 tokens -> 2 full shareable pages at page_size=8
+
+        eng.pool.sharing = False
+        eng.rebind_obs()
+        eng.submit("base", prompt, 4)
+        base = np.asarray(eng.run()["base"])
+
+        eng.pool.sharing = True
+        eng.rebind_obs(ownlog=log)
+        eng.submit("twin_a", prompt, 4)
+        eng.submit("twin_b", prompt, 4)  # same wave: no segment between
+        res = eng.run()
+        np.testing.assert_array_equal(np.asarray(res["twin_a"]), base)
+        np.testing.assert_array_equal(np.asarray(res["twin_b"]), base)
+
+        kinds = [e["kind"] for e in log.snapshot()["events"]]
+        assert sum(1 for k in kinds if k == "share") >= 1
+        assert "cow" not in kinds  # neither twin writes the shared pages
+        occ = eng.page_occupancy()
+        assert occ["free_pages"] == occ["n_pages"], "pages leaked"
+        assert eng.pool.cached_pages >= 2  # prefix retained for revival
+        assert [d.code for d in analyze_pages(log).diagnostics] == []
+    finally:
+        eng.pool.sharing = False
         eng.attach_ownership_log(None)
         eng.reset()

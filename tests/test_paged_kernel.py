@@ -108,6 +108,116 @@ def test_kernel_masks_poisoned_trash_page():
     np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(outs[1]))
 
 
+# -- ragged multi-token-q (chunked prefill) ----------------------------------
+
+def _ragged_state(S, Hkv, hd, ps, ppseq, spans, seed=0, poison=True):
+    """Random pools + a page table covering each slot's base context AND
+    its chunk rows (``spans`` is ``[(base_len, q_len), ...]``) — the
+    chunk's K/V are already scattered (write-then-attend at chunk
+    granularity), so any pool content exercises both paths equally."""
+    rng = np.random.RandomState(seed)
+    n_pages = S * ppseq + 1
+    k_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+    v_pool = jnp.asarray(rng.randn(n_pages, ps, Hkv, hd), jnp.float32)
+    if poison:
+        k_pool = k_pool.at[TRASH_PAGE].set(1e9)
+        v_pool = v_pool.at[TRASH_PAGE].set(1e9)
+    pt = np.full((S, ppseq), TRASH_PAGE, np.int32)
+    page = 1
+    for s, (L, QL) in enumerate(spans):
+        for j in range((max(L + QL, 1) + ps - 1) // ps):
+            pt[s, j] = page
+            page += 1
+    ln = jnp.asarray([L for L, _ in spans], jnp.int32)
+    ql = jnp.asarray([QL for _, QL in spans], jnp.int32)
+    return k_pool, v_pool, jnp.asarray(pt), ln, ql
+
+
+# (name, S, Hq, Hkv, hd, ps, ppseq, Tn, [(base_len, q_len), ...])
+RAGGED_FIXTURES = [
+    # chunk rows cross a physical page boundary mid-chunk
+    ("chunk_straddles_page", 2, 4, 2, 8, 16, 3, 8, [(13, 8), (21, 8)]),
+    # chunk length == page_size: the chunk fills one page exactly
+    ("chunk_eq_page", 2, 4, 2, 8, 16, 3, 16, [(0, 16), (16, 16)]),
+    # ragged tail: final chunk shorter than the padded Tn grid, plus an
+    # idle slot (q_len == 0) whose rows are all padding
+    ("final_partial_and_idle", 3, 4, 2, 8, 16, 3, 8,
+     [(32, 3), (5, 0), (0, 8)]),
+    # GQA: 4 query heads per KV head across chunk rows
+    ("gqa_chunk_heads", 2, 8, 2, 16, 16, 2, 8, [(15, 8), (0, 5)]),
+    # small pages: one chunk spans three physical pages
+    ("small_pages_chunk", 2, 4, 2, 8, 4, 3, 8, [(2, 8), (0, 1)]),
+]
+
+
+@pytest.mark.parametrize(
+    "name,S,Hq,Hkv,hd,ps,ppseq,Tn,spans",
+    RAGGED_FIXTURES, ids=[f[0] for f in RAGGED_FIXTURES],
+)
+def test_ragged_kernel_matches_gather(name, S, Hq, Hkv, hd, ps, ppseq,
+                                      Tn, spans):
+    k_pool, v_pool, pt, L, ql = _ragged_state(S, Hkv, hd, ps, ppseq, spans)
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(S, Hq, Tn, hd), jnp.float32)
+    scale = hd ** -0.5
+    ref = paged_decode_attention(
+        q, k_pool, v_pool, pt, L, scale, impl="xla", q_lens=ql
+    )
+    got = paged_decode_attention(
+        q, k_pool, v_pool, pt, L, scale, impl="pallas_interpret", q_lens=ql
+    )
+    assert bool(jnp.all(jnp.isfinite(got))), f"{name}: non-finite output"
+    # compare REAL rows only (t < q_lens[s]); padding rows are
+    # documented as finite-but-meaningless
+    mask = (np.arange(Tn)[None, :] < np.asarray(ql)[:, None])
+    m4 = jnp.asarray(mask.astype(np.float32))[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(got * m4), np.asarray(ref * m4), atol=1e-5, rtol=1e-5,
+        err_msg=f"{name}: ragged kernel diverged from gather path",
+    )
+
+
+def test_ragged_kernel_masks_poisoned_trash_page():
+    """Poison on/off must not change any real chunk row: pages past a
+    slot's base+chunk rows gather the trash page and are masked."""
+    S, Hq, Hkv, hd, ps, ppseq, Tn = 2, 4, 2, 8, 16, 3, 8
+    spans = [(13, 8), (3, 5)]
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(S, Hq, Tn, hd), jnp.float32)
+    outs = []
+    for poison in (False, True):
+        k_pool, v_pool, pt, L, ql = _ragged_state(
+            S, Hkv, hd, ps, ppseq, spans, seed=2, poison=poison
+        )
+        outs.append(paged_decode_attention(
+            q, k_pool, v_pool, pt, L, hd ** -0.5,
+            impl="pallas_interpret", q_lens=ql,
+        ))
+    mask = (np.arange(Tn)[None, :] <
+            np.asarray([QL for _, QL in spans])[:, None])
+    m4 = np.asarray(mask, np.float32)[:, None, :, None]
+    np.testing.assert_array_equal(
+        np.asarray(outs[0]) * m4, np.asarray(outs[1]) * m4
+    )
+
+
+def test_ragged_q_requires_q_lens_and_rejects_k_new():
+    S, Hq, Hkv, hd, ps, ppseq = 2, 4, 2, 8, 16, 2
+    k_pool, v_pool, pt, L, ql = _ragged_state(
+        S, Hkv, hd, ps, ppseq, [(0, 8), (3, 8)]
+    )
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(S, Hq, 8, hd), jnp.float32)
+    with pytest.raises(ValueError, match="requires per-slot q_lens"):
+        paged_decode_attention(q, k_pool, v_pool, pt, L, impl="xla")
+    kn = jnp.asarray(rng.randn(S, Hkv, 1, hd), jnp.float32)
+    with pytest.raises(ValueError, match="no k_new"):
+        paged_decode_attention(
+            q, k_pool, v_pool, pt, L, impl="xla", q_lens=ql,
+            k_new=kn, v_new=kn,
+        )
+
+
 # -- shared impl dispatch ----------------------------------------------------
 
 def test_resolve_attention_impl_rules():
@@ -153,8 +263,14 @@ def test_paged_pallas_supported_shapes():
     # interpret mode only needs structural validity, not lowering tiles
     assert paged_pallas_supported(q, (64, 6, 2, 8), interpret=True)
     assert not paged_pallas_supported(q, (64, 6, 2, 8), interpret=False)
-    # multi-token q / head mismatch are structurally unsupported
-    assert not paged_pallas_supported((4, 4, 2, 8), pool_ok, interpret=True)
+    # multi-token q is the ragged prefill-chunk path: structurally
+    # supported; compiled mode additionally requires the chunk rows to
+    # fill the sublane tile (q_tokens constraint)
+    assert paged_pallas_supported((4, 4, 2, 8), pool_ok, interpret=True)
+    assert paged_pallas_supported((4, 4, 8, 8), pool_ok, interpret=False)
+    assert not paged_pallas_supported((4, 4, 7, 8), pool_ok,
+                                      interpret=False)
+    # head mismatch stays structurally unsupported
     assert not paged_pallas_supported((4, 3, 1, 8), (64, 16, 2, 8),
                                       interpret=True)
 
@@ -278,3 +394,46 @@ def test_dec005_silent_on_default_geometry_and_without_specs():
     ineligible = build_paged_decode_dag(cfg, slots=2, page_size=6)
     rep2 = analyze(ineligible.graph)
     assert not rep2.has("DEC005")
+
+
+# -- DEC006 chunk-size diagnostic --------------------------------------------
+
+def test_dec006_fires_on_degenerate_chunk_size():
+    from distributed_llm_scheduler_tpu.analysis import analyze
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    dag = build_paged_decode_dag(cfg, slots=2)  # eligible ps=16, hd=8
+    # ragged-kernel ineligible chunk: 7 rows misses the 8-row sublane
+    rep = analyze(dag.graph, params=dag.param_specs, chunk_tokens=7)
+    dec6 = [d for d in rep.diagnostics if d.code == "DEC006"]
+    assert len(dec6) == 1 and dec6[0].severity.name == "WARNING"
+    assert "q_tokens 7" in dec6[0].message
+    assert rep.exit_code == 0  # a warning, never a gate
+    # oversized chunk: exceeds the slots*seg_steps per-segment budget
+    rep2 = analyze(dag.graph, params=dag.param_specs,
+                   chunk_tokens=48, decode_budget=32)
+    dec6 = [d for d in rep2.diagnostics if d.code == "DEC006"]
+    assert len(dec6) == 1
+    assert "exceeds the per-segment decode-token capacity 32" \
+        in dec6[0].message
+
+
+def test_dec006_silent_on_sane_chunk_and_without_chunking():
+    from distributed_llm_scheduler_tpu.analysis import analyze
+    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
+        build_paged_decode_dag,
+    )
+    from distributed_llm_scheduler_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny()
+    dag = build_paged_decode_dag(cfg, slots=2)
+    rep = analyze(dag.graph, params=dag.param_specs,
+                  chunk_tokens=16, decode_budget=32)
+    assert not rep.has("DEC006")
+    # chunking off -> the check never runs
+    rep2 = analyze(dag.graph, params=dag.param_specs)
+    assert not rep2.has("DEC006")

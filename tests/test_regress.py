@@ -38,6 +38,12 @@ BASE = {
     "serve.prefix.goodput_gain": 1.6,
     "serve.prefix.shared_page_hits": 25,
     "serve.prefix.pages_leaked": 0,
+    "serve.chunked.tpot_p99_ms": 91.4,
+    "serve.chunked.ttft_p99_ms": 3397.6,
+    "serve.chunked.goodput_tok_s": 36.3,
+    "serve.chunked.tpot_p99_gain": 1.41,
+    "serve.chunked.token_parity": True,
+    "serve.chunked.pages_leaked": 0,
     "decode.paged_tokens_exact": True,
     "decode.pages_leaked": 0,
     "decode.kernel_tokens_exact": True,

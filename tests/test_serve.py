@@ -402,6 +402,54 @@ def test_forced_alias_triggers_cow_and_keeps_tokens_bitwise(_engine):
         eng.rebind_obs(clock=VirtualClock())
 
 
+def test_chunked_prefill_bitwise_across_chunk_sizes(_engine):
+    """Chunked prefill is a SCHEDULING change only: the same mixed
+    long/short workload decodes to bitwise-identical token streams with
+    chunking off, chunk_tokens=8 (the 24-token long splits into three
+    chunks), and chunk_tokens=16 (two ragged chunks) — with zero page
+    leaks and the chunk counters accounting for every prefill token."""
+    from distributed_llm_scheduler_tpu.obs.metrics import MetricsRegistry
+
+    eng, pool = _engine()
+
+    def workload():
+        rng = np.random.RandomState(0)
+        eng.submit("long", jnp.asarray(
+            rng.randint(1, 50, size=(1, 24)), jnp.int32), 4)
+        for i in range(5):
+            plen = int(rng.choice([3, 5, 8]))
+            eng.submit(f"s{i}", jnp.asarray(
+                rng.randint(1, 50, size=(1, plen)), jnp.int32), 3)
+        out = eng.run()
+        leak = (eng.pool.n_pages - 1) - eng.pool.free_pages
+        return {k: np.asarray(v) for k, v in out.items()}, leak
+
+    whole, leak_w = workload()
+    assert leak_w == 0
+    try:
+        m = MetricsRegistry()
+        eng.rebind_obs(clock=VirtualClock(), metrics=m)
+        eng.chunk_tokens = 8
+        chunk8, leak_8 = workload()
+        assert leak_8 == 0
+        assert m.counter("decode.chunk_admitted").value >= 1
+        assert m.counter("decode.chunk_waves").value >= 2
+        assert m.counter("decode.chunk_prefill_tokens").value == 24
+
+        eng.reset()
+        eng.chunk_tokens = 16
+        chunk16, leak_16 = workload()
+        assert leak_16 == 0
+    finally:
+        eng.chunk_tokens = None
+        eng.rebind_obs(clock=VirtualClock())
+
+    assert whole.keys() == chunk8.keys() == chunk16.keys()
+    for k in whole:
+        np.testing.assert_array_equal(whole[k], chunk8[k])
+        np.testing.assert_array_equal(whole[k], chunk16[k])
+
+
 def test_frontend_rejects_bad_config(_engine):
     eng, _pool = _engine()
     arrivals = [Arrival("a", 0.0, 8, 4)]
