@@ -1731,7 +1731,14 @@ def cmd_doctor(args) -> int:
     (``memory``) and the measured-vs-predicted peak comparison
     (``mem_drift``).  Exit 2 when nothing was recorded or the timeline
     invariant fails, 1 when any device's two-sided drift ratio exceeds
-    ``--mem-drift-threshold``, 0 otherwise."""
+    ``--mem-drift-threshold``, 0 otherwise.
+
+    ``--requests`` switches to the REQUEST doctor: per-request
+    waterfall latency attribution with exact tiling and ranked
+    aggressor→victim interference pairs, live (bare flag) or offline
+    over a saved serve artifact / flight dump / request log.  Exit 1
+    when a breaching request's dominant wait bucket exceeds
+    ``--dominant-threshold``, 2 malformed."""
     from .obs.attribution import attribute_run, attribute_trace
 
     if getattr(args, "memory", False):
@@ -1742,6 +1749,8 @@ def cmd_doctor(args) -> int:
         return _cmd_doctor_soak(args)
     if getattr(args, "serve", None):
         return _cmd_doctor_serve(args)
+    if getattr(args, "requests", None):
+        return _cmd_doctor_requests(args)
     if args.trace:
         try:
             att = attribute_trace(args.trace)
@@ -1976,6 +1985,139 @@ def _cmd_doctor_serve(args) -> int:
         d = rep.errors[0]
         print(f"doctor: {d.code}: {d.message}", file=sys.stderr)
         return 1
+    return 0
+
+
+def _cmd_doctor_requests(args) -> int:
+    """The request doctor (``doctor --requests [live|ART_JSON]``):
+    per-request waterfall attribution — each request's e2e decomposed
+    into the eight interference buckets (exact tiling to 1e-9) with the
+    ranked aggressor→victim pairs.
+
+    ``live`` (the default when the flag is bare) serves the serve-bench
+    overload scenario on a virtual clock with the waterfall recorder
+    wired, so the attribution runs span-exact.  A path re-gates a saved
+    artifact offline: a ``dls.serve/1`` artifact (each leg's rows), a
+    flight-recorder dump (its ``request_log``; pass the matching
+    ``flight_trace.json`` via ``--requests-trace`` to upgrade rows-only
+    to span attribution), or a bare ``dls.requests/1`` snapshot.  Exit 2
+    malformed/empty, 1 when a breaching request's dominant wait bucket
+    exceeds ``--dominant-threshold``, 0 otherwise."""
+    from .obs.interference import attribute_requests, events_from_perfetto
+
+    events = None
+    if getattr(args, "requests_trace", None):
+        try:
+            with open(args.requests_trace) as f:
+                events = events_from_perfetto(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"doctor --requests-trace: {e}", file=sys.stderr)
+            return 2
+    ttft_target = getattr(args, "slo_ttft", None)
+    threshold = getattr(args, "dominant_threshold", 0.5)
+
+    legs = {}
+    if args.requests == "live":
+        from .eval import serve_bench
+        from .obs.slo import SLOPolicy
+        from .obs.trace import Tracer
+        from .serve.frontend import (
+            ServiceTimeModel,
+            ServingFrontend,
+            VirtualClock,
+        )
+        from .serve.loadgen import poisson_arrivals
+
+        sc = serve_bench.SCENARIO
+        clock = VirtualClock()
+        eng, _pool = serve_bench.build_serve_engine(
+            slots=sc["slots"], page_size=sc["page_size"],
+            n_pages=sc["n_pages"], pages_per_seq=sc["pages_per_seq"],
+            seg_steps=sc["seg_steps"], clock=clock,
+        )
+        eng.rebind_obs(clock=clock, tracer=Tracer(clock=clock))
+        arrivals = poisson_arrivals(
+            sc["rate_rps"], sc["n_requests"], args.seed or 7,
+            prompt_lens=sc["prompt_lens"],
+            max_new_tokens=sc["max_new_tokens"],
+            priorities=sc["priorities"],
+            priority_weights=sc["priority_weights"],
+        )
+        policy = SLOPolicy(
+            ttft_s=sc["ttft_s"], window_s=sc["window_s"],
+            percentile=sc["percentile"],
+        )
+        tm = ServiceTimeModel(
+            wave_s=sc["wave_s"], segment_s=sc["segment_s"],
+            idle_s=sc["idle_s"],
+        )
+        fe = ServingFrontend(
+            eng, arrivals, policy, admission="slo", preemption=True,
+            time_model=tm,
+        )
+        rep = fe.run()
+        if ttft_target is None:
+            ttft_target = sc["ttft_s"]
+        legs["live"] = (rep["requests"], list(eng.tracer.events))
+    else:
+        try:
+            with open(args.requests) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"doctor --requests: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(obj, dict):
+            print(f"doctor --requests: {args.requests} is not a JSON "
+                  "object", file=sys.stderr)
+            return 2
+        schema = obj.get("schema")
+        if schema == "dls.serve/1":
+            if ttft_target is None:
+                ttft_target = (obj.get("policy") or {}).get("ttft_s")
+            for name, leg in (obj.get("legs") or {}).items():
+                rows = leg.get("requests")
+                if rows:
+                    legs[name] = (rows, events)
+        elif isinstance(obj.get("request_log"), dict):
+            legs["flight"] = (
+                obj["request_log"].get("requests") or [], events
+            )
+        elif schema == "dls.requests/1":
+            legs["requests"] = (obj.get("requests") or [], events)
+        else:
+            print(f"doctor --requests: no request rows in "
+                  f"{args.requests} (want dls.serve/1, a flight dump, "
+                  "or dls.requests/1)", file=sys.stderr)
+            return 2
+    reports = {
+        name: attribute_requests(
+            rows, events=evs, ttft_target_s=ttft_target,
+            threshold=threshold,
+        )
+        for name, (rows, evs) in legs.items()
+    }
+    print(json.dumps(
+        {"interference": {
+            name: r.summary() for name, r in reports.items()
+        }},
+        indent=1, sort_keys=True,
+    ))
+    if not any(r.n_attributed for r in reports.values()):
+        print("doctor --requests: no attributable requests "
+              "(every row lacks a terminal timestamp)", file=sys.stderr)
+        return 2
+    for name, r in sorted(reports.items()):
+        if r.exceeds():
+            f0 = r.findings[0]
+            agg = f0.get("top_aggressor")
+            print(
+                f"doctor: [{name}] request {f0['rid']} breached "
+                f"ttft {f0['ttft_s']:.6g}s > {ttft_target:.6g}s with "
+                f"{f0['dominant']} = {f0['dominant_frac']:.0%} of e2e"
+                + (f" (top aggressor: {agg})" if agg else ""),
+                file=sys.stderr,
+            )
+            return 1
     return 0
 
 
@@ -2565,6 +2707,26 @@ def main(argv=None) -> int:
                         "through the page-lifetime (PGL00x) and "
                         "request-lifecycle (LCY00x) passes (exit 1 on "
                         "findings, 2 malformed)")
+    p.add_argument("--requests", nargs="?", const="live", default=None,
+                   metavar="ART_JSON",
+                   help="request doctor: per-request waterfall latency "
+                        "attribution (exact bucket tiling + ranked "
+                        "aggressor→victim pairs) — bare flag runs the "
+                        "serve-bench scenario live with the waterfall "
+                        "recorder; a path re-gates a dls.serve/1 "
+                        "artifact, flight dump, or dls.requests/1 "
+                        "snapshot offline (exit 1 when a breaching "
+                        "request is wait-dominated, 2 malformed)")
+    p.add_argument("--requests-trace", default=None, dest="requests_trace",
+                   metavar="TRACE_JSON",
+                   help="with --requests FLIGHT_DUMP: the matching "
+                        "flight_trace.json, upgrading rows-only "
+                        "attribution to span-exact")
+    p.add_argument("--dominant-threshold", type=float, default=0.5,
+                   dest="dominant_threshold", metavar="FRAC",
+                   help="with --requests: exit 1 when a breaching "
+                        "request's dominant wait bucket exceeds this "
+                        "fraction of its e2e (default 0.5)")
     p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(

@@ -345,6 +345,7 @@ class PagedDecodeEngine:
         from ..obs import (
             MetricsRegistry,
             RequestLog,
+            RequestTraceRecorder,
             TeeTracer,
             ambient_flight,
             ambient_metrics,
@@ -451,6 +452,12 @@ class PagedDecodeEngine:
                 self.tracer = self.flight.tracer
             else:
                 self.tracer = TeeTracer(self.tracer, self.flight.tracer)
+        # per-request waterfall recorder: rides the tracer, inheriting
+        # its None-guard contract — no tracer, no recorder, no work
+        self.reqtrace = (
+            RequestTraceRecorder(self.tracer)
+            if self.tracer is not None else None
+        )
         # request lifecycle log: always on, like the registry — recording
         # is a dict write per lifecycle seam, host side, outside the
         # scanned program.  Timestamps are the SAME clock reads the
@@ -561,6 +568,8 @@ class PagedDecodeEngine:
 
         self.reqlog = RequestLog(clock=self._clock)
         self._reqlogs = self._req_sinks()
+        if self.reqtrace is not None:
+            self.reqtrace.reset()
 
     def rebind_obs(
         self,
@@ -589,6 +598,7 @@ class PagedDecodeEngine:
         from ..obs import (
             MetricsRegistry,
             RequestLog,
+            RequestTraceRecorder,
             TeeTracer,
             ambient_flight,
             ambient_metrics,
@@ -609,6 +619,10 @@ class PagedDecodeEngine:
                 self.tracer = self.flight.tracer
             else:
                 self.tracer = TeeTracer(self.tracer, self.flight.tracer)
+        self.reqtrace = (
+            RequestTraceRecorder(self.tracer)
+            if self.tracer is not None else None
+        )
         self.memprof = memprof
         # undo fault injectors before reset(): a wrapped pool must not
         # receive the stale pages reset() frees, so drop the slot->page
@@ -739,6 +753,8 @@ class PagedDecodeEngine:
                 src = int(self.page_table[s, li])
                 if self.pool.refcount(src) <= 1:
                     continue
+                t_c0 = (self._clock()
+                        if self.reqtrace is not None else None)
                 dst = self.pool.alloc(1)[0]
                 rid = str(self._slot_req[s])
                 if self.ownlog is not None:
@@ -760,6 +776,9 @@ class PagedDecodeEngine:
                         refcounts=[self.pool.refcount(dst)],
                     )
                 self.metrics.counter("decode.cow_splits").inc()
+                if self.reqtrace is not None:
+                    self.reqtrace.cow(rid, t_c0, self._clock(),
+                                      src=src, dst=dst)
 
     @property
     def _cow_copy(self):
@@ -900,6 +919,14 @@ class PagedDecodeEngine:
         self._submit_t[rid] = t_sub
         for rl in self._reqlogs:
             rl.submit(rid, int(prompt_ids.shape[1]), max_new_tokens, t_sub)
+        if self.reqtrace is not None:
+            # idempotent: a serving frontend may have registered this
+            # rid already at its ARRIVAL anchor; a derived resume rid
+            # re-joins the first pass's track
+            self.reqtrace.submit(
+                rid, t_sub, prompt_len=int(prompt_ids.shape[1]),
+                max_new_tokens=max_new_tokens,
+            )
         self.metrics.counter("decode.requests_submitted").inc()
         self._emit_queue_depth()
 
@@ -1189,6 +1216,8 @@ class PagedDecodeEngine:
                 "admit_chunked", track="decode", cat="decode", t=t0,
                 rid=str(rid), prompt_len=P,
             )
+        if self.reqtrace is not None:
+            self.reqtrace.admitted(rid, t0, chunked=True)
         self._emit_pool_occupancy()
         self._emit_queue_depth()
 
@@ -1212,17 +1241,20 @@ class PagedDecodeEngine:
         if budget is None:
             budget = max(ct, self.slots * self.seg_steps)
         advanced = 0
+        spent_by: list = []   # rids whose chunks consumed budget here
         order = sorted(self._chunk_state)
         n = len(order)
         rr = self._chunk_rr % n
         for k in range(n):
             if budget <= 0:
+                self._trace_budget_stalls(spent_by)
                 break
             s = order[(rr + k) % n]
             st = self._chunk_state[s]
             P, base = st["P"], st["next"]
             C = min(ct, P - base)
             if C > budget:
+                self._trace_budget_stalls(spent_by)
                 break
             final = base + C >= P
             target_rows = P + st["max_new"] if final else base + C
@@ -1232,6 +1264,24 @@ class PagedDecodeEngine:
             if need > 0:
                 if not self.pool.can_alloc(need):
                     self.metrics.counter("decode.chunk_stalls").inc()
+                    if self.tracer is not None:
+                        # the counter TOTAL rides the ring so the
+                        # flight recorder's chunk_stall trigger can see
+                        # sustained growth post hoc
+                        self.tracer.counter(
+                            "decode.chunk_stalls",
+                            self.metrics.counter(
+                                "decode.chunk_stalls"
+                            ).value,
+                        )
+                    if self.reqtrace is not None:
+                        self.reqtrace.wait(
+                            st["rid"], self._clock(), "page_pool",
+                            by=[
+                                str(r) for r in self._slot_req
+                                if r is not None and r != st["rid"]
+                            ],
+                        )
                     continue
                 fresh = self.pool.alloc(need)
                 k0 = len(self._slot_pages[s])
@@ -1265,6 +1315,14 @@ class PagedDecodeEngine:
             )
             if ev is not None:
                 self.tracer.end(ev)
+                if self.reqtrace is not None:
+                    # same timestamps as the decode-track span: the
+                    # waterfall and the engine timeline cannot disagree
+                    self.reqtrace.chunk(
+                        st["rid"], ev["t0"], ev["t1"], base=base,
+                        tokens=C,
+                    )
+            spent_by.append(str(st["rid"]))
             st["next"] = base + C
             advanced += C
             budget -= C
@@ -1291,6 +1349,8 @@ class PagedDecodeEngine:
         del self._chunk_state[s]
         for rl in self._reqlogs:
             rl.first_token(rid, t_done)
+        if self.reqtrace is not None:
+            self.reqtrace.first_token(rid, t_done)
         sub_t = self._submit_t.pop(rid, None)
         if sub_t is not None:
             self.metrics.histogram("decode.ttft_s", unit="s").observe(
@@ -1298,6 +1358,41 @@ class PagedDecodeEngine:
             )
         if st["max_new"] == 1:  # the fold produced the only token
             self._retire(s)
+
+    def _trace_budget_stalls(self, spent_by: list) -> None:
+        """The per-segment prefill token budget ran out: every chunk
+        slot still mid-prefill waits on ``chunk_budget``, charged to
+        the requests whose chunks consumed the budget this segment and
+        the co-resident decoders the budget is sized around."""
+        rt = self.reqtrace
+        if rt is None:
+            return
+        t = self._clock()
+        decoders = [
+            str(self._slot_req[s]) for s in range(self.slots)
+            if self._slot_req[s] is not None and self.remaining[s] > 0
+        ]
+        by = list(dict.fromkeys(list(spent_by) + decoders))
+        for st in self._chunk_state.values():
+            rid = str(st["rid"])
+            if rid in spent_by or st["next"] >= st["P"]:
+                continue
+            rt.wait(rid, t, "chunk_budget", by=by)
+
+    def _trace_queue_block(self, cause: str) -> None:
+        """Stamp WHY admission stopped onto every queued request's
+        waterfall: the head waits on the named resource (aggressors =
+        the current residents holding it), everyone behind it waits on
+        the head — FIFO head-of-line blocking made visible."""
+        rt = self.reqtrace
+        if rt is None or not self._queue:
+            return
+        t = self._clock()
+        holders = [str(r) for r in self._slot_req if r is not None]
+        head = str(self._queue[0][0])
+        rt.wait(head, t, cause, by=holders)
+        for entry in self._queue[1:]:
+            rt.wait(str(entry[0]), t, "head_of_line", by=[head])
 
     # -- admission / retirement (between segments) -------------------------
     def _admit(self) -> int:
@@ -1326,6 +1421,7 @@ class PagedDecodeEngine:
                 s for s in range(self.slots) if self._slot_req[s] is None
             ]
             if not free_slots:
+                self._trace_queue_block("slots_full")
                 break
             P = self._queue[0][1].shape[1]
             if self.chunk_eligible(int(P)):
@@ -1334,6 +1430,7 @@ class PagedDecodeEngine:
                 if pages_needed(
                     min(self.chunk_tokens, int(P)), self.page_size
                 ) > self.pool.free_pages:
+                    self._trace_queue_block("page_pool")
                     break  # backpressure: head waits for frees
                 self._admit_chunked(free_slots[0])
                 admitted += 1
@@ -1385,6 +1482,7 @@ class PagedDecodeEngine:
                         seen_keys.add(kt)
                     hits.append((spages, keys))
             if not batch:
+                self._trace_queue_block("page_pool")
                 break  # backpressure: head waits for frees
             del self._queue[:len(batch)]
             ev_wave = None
@@ -1500,6 +1598,15 @@ class PagedDecodeEngine:
                 for rl in self._reqlogs:
                     rl.admit(rid, t_pf0)
                     rl.first_token(rid, t_adm)
+                if self.reqtrace is not None:
+                    self.reqtrace.admitted(
+                        rid, t_pf0, wave=[b[0] for b in batch],
+                    )
+                    self.reqtrace.prefill(
+                        rid, t_pf0, t_adm, tokens=int(P),
+                        wave_size=len(batch), shared_pages=h0,
+                    )
+                    self.reqtrace.first_token(rid, t_adm)
                 sub_t = self._submit_t.pop(rid, None)
                 if sub_t is not None:
                     ttft_h.observe(t_adm - sub_t)
@@ -1550,10 +1657,17 @@ class PagedDecodeEngine:
                 "retire", track="decode", cat="decode", t=t_ret,
                 rid=str(rid), tokens=n,
             )
+        if self.reqtrace is not None:
+            self.reqtrace.retire(rid, t_ret, tokens=n)
 
-    def preempt(self, rid: Any) -> Dict[str, Any]:
+    def preempt(
+        self, rid: Any, *, cause: Optional[str] = None, by: Any = None,
+    ) -> Dict[str, Any]:
         """Evict an in-flight request: free its pages back to the pool
         and hand the generated prefix to the caller for re-queueing.
+        ``cause`` stamps the lifecycle record's terminal cause code
+        (e.g. ``preempt_tier0_victim``); ``by`` names the request the
+        eviction made room for (the waterfall's interference arrow).
 
         Preemption is the capacity lever priority scheduling needs: a
         high-tier arrival that cannot be admitted (no free slot, no free
@@ -1600,7 +1714,7 @@ class PagedDecodeEngine:
         self._first_tok_t.pop(rid, None)
         t_pre = self._clock()
         for rl in self._reqlogs:
-            rl.preempt(rid, t_pre)
+            rl.preempt(rid, t_pre, cause)
         self.metrics.counter("decode.requests_preempted").inc()
         if self.tracer is not None:
             self.tracer.instant(
@@ -1608,6 +1722,8 @@ class PagedDecodeEngine:
                 rid=str(rid), tokens=int(tokens.shape[0]),
                 remaining=remaining,
             )
+        if self.reqtrace is not None:
+            self.reqtrace.preempt(rid, t_pre, by=by, cause=cause)
         self._emit_pool_occupancy()
         return {"rid": rid, "tokens": tokens, "remaining": remaining}
 
@@ -1658,6 +1774,23 @@ class PagedDecodeEngine:
                 cat="decode", steps=self.seg_steps,
                 active=int((owed > 0).sum()),
             )
+        if self.reqtrace is not None:
+            # per-request decode spans reuse the segment's two hoisted
+            # timestamps: the waterfall cannot disagree with the engine
+            # timeline, and the bare run reads the clock no extra time
+            residents = [
+                str(self._slot_req[s]) for s in range(self.slots)
+                if self._slot_req[s] is not None and owed[s] > 0
+            ]
+            for s in range(self.slots):
+                rid = self._slot_req[s]
+                if rid is None or owed[s] <= 0:
+                    continue
+                self.reqtrace.segment(
+                    rid, t_sg0, t_sg1,
+                    tokens=int(min(int(owed[s]), self.seg_steps)),
+                    co_resident=residents,
+                )
         # slot state advances host-side: each slot ran min(owed, K)
         # active steps, its current token is the last one it emitted
         ran = self._np.minimum(owed, self.seg_steps)

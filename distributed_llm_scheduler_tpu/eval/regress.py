@@ -46,6 +46,9 @@ LOWER_BETTER = (
     "serve.chunked.tpot_p99_ms",
     "serve.chunked.ttft_p99_ms",
     "serve.chunked.pages_leaked",
+    # the interference-attribution tiling invariant: buckets must sum
+    # to each request's e2e exactly, so the worst residual is pinned 0
+    "serve.attribution.max_residual_s",
     # soak health slopes (dls.soak/1 artifact): clamped to >= 0, a
     # healthy run sits at or near 0 — any growth is a leak/degradation
     "soak.page_leak_slope_pages_s",
@@ -99,6 +102,7 @@ METRIC_DEFAULT_TOLERANCES = {
     "serve.chunked.goodput_tok_s": 0.0,
     "serve.chunked.tpot_p99_gain": 0.0,
     "serve.chunked.pages_leaked": 0.0,
+    "serve.attribution.max_residual_s": 0.0,
     # soak slopes share the serve bench's VirtualClock determinism: the
     # timestamps and token counts behind every Theil-Sen fit are pure
     # functions of the seed, so exact match is the right band even
@@ -176,6 +180,7 @@ DEFAULT_METRICS = (
     "serve.chunked.tpot_p99_gain",
     "serve.chunked.token_parity",
     "serve.chunked.pages_leaked",
+    "serve.attribution.max_residual_s",
     "decode.paged_tokens_exact",
     "decode.pages_leaked",
     "decode.kernel_tokens_exact",

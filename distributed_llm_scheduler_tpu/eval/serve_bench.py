@@ -253,6 +253,14 @@ def measure_serving(seed: int = 7,
     repeat = run_serving_leg(arrivals, policy, "slo", True, tm, sc,
                              engine=eng)
     deterministic = slo["digest"] == repeat["digest"]
+    from ..obs.interference import attribute_requests
+
+    attribution = {
+        name: attribute_requests(
+            leg["requests"], ttft_target_s=sc["ttft_s"]
+        ).summary(requests=False)
+        for name, leg in (("fifo_admit_all", fifo), ("slo_preempt", slo))
+    }
     art = {
         "schema": SCHEMA,
         "seed": seed,
@@ -270,6 +278,7 @@ def measure_serving(seed: int = 7,
         "time_model": tm.to_json(),
         "attention_impl": eng.summary()["attention_impl"],
         "legs": {"fifo_admit_all": fifo, "slo_preempt": slo},
+        "attribution": attribution,
         "deterministic": deterministic,
         "goodput_gain_vs_fifo": (
             slo["goodput_tok_s"] / fifo["goodput_tok_s"]
@@ -280,6 +289,10 @@ def measure_serving(seed: int = 7,
         "serve.goodput_tok_s": slo["goodput_tok_s"],
         "serve.ttft_p99_ms": slo["ttft_p99_ms"],
         "serve.queue_wait_p95_ms": slo["queue_wait_p95_ms"],
+        # the tiling invariant, flattened so regress can pin it at 0
+        "serve.attribution.max_residual_s": max(
+            a["max_residual_s"] for a in attribution.values()
+        ),
     }
     if prefix:
         art["prefix"] = measure_prefix_sharing(

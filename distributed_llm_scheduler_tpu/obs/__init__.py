@@ -22,7 +22,13 @@ subsystems (planned dispatch, segment fusion, paged decode):
   goodput vs raw throughput, breach gate) over the request log;
 * :mod:`.flight` — always-on bounded ring-buffer flight recorder that
   dumps trace + request log on SLO breach / near-OOM / straggler /
-  soak health breach;
+  soak health breach / sustained chunk-budget stalls;
+* :mod:`.reqtrace` — per-request waterfall tracks (cause-stamped wait
+  spans, compute spans, lifecycle instants, interference flow arrows)
+  re-projected from the engine's hoisted clock reads;
+* :mod:`.interference` — the request doctor's exact latency
+  attribution: per-request e2e decomposed into wait/compute buckets
+  that tile it to ≤1e-9, with ranked aggressor→victim pairs;
 * :mod:`.clockutil` — the ONE injected-or-default timebase decision
   every module above routes its ``clock`` argument through;
 * :mod:`.timeseries` — bounded-memory time series (fixed capacity,
@@ -57,6 +63,11 @@ from .attribution import Attribution, attribute_run, attribute_trace
 from .clockutil import Clock, default_clock, resolve_clock
 from .drift import DriftReport, compute_drift
 from .flight import FlightRecorder, RingTracer, TeeTracer
+from .interference import (
+    InterferenceReport,
+    attribute_requests,
+    events_from_perfetto,
+)
 from .health import (
     Detector,
     HealthFinding,
@@ -71,9 +82,11 @@ from .metrics import MetricsRegistry
 from .reqlog import (
     RequestLog,
     RequestRecord,
+    stitch_logical_chains,
     summarize_request_log,
     validate_request_log,
 )
+from .reqtrace import RequestTraceRecorder, base_rid, request_track
 from .slo import SLOPolicy, SLOReport, evaluate_slo
 from .timeseries import (
     Series,
@@ -155,11 +168,13 @@ __all__ = [
     "HealthFinding",
     "HealthMonitor",
     "HealthReport",
+    "InterferenceReport",
     "MemDriftReport",
     "MemoryProfiler",
     "MetricsRegistry",
     "RequestLog",
     "RequestRecord",
+    "RequestTraceRecorder",
     "RingTracer",
     "SLOPolicy",
     "SLOReport",
@@ -171,20 +186,25 @@ __all__ = [
     "ambient_flight",
     "ambient_metrics",
     "ambient_tracer",
+    "attribute_requests",
     "attribute_run",
     "attribute_trace",
+    "base_rid",
     "compute_drift",
     "compute_mem_drift",
     "default_clock",
     "default_detectors",
     "evaluate_slo",
+    "events_from_perfetto",
     "flight_enabled",
     "load_timeseries",
     "report_from_soak_artifact",
+    "request_track",
     "reset_ambient",
     "resolve_clock",
     "save_timeseries",
     "snapshot_at",
+    "stitch_logical_chains",
     "summarize_request_log",
     "theil_sen_slope",
     "trace_enabled",

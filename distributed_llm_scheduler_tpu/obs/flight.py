@@ -178,9 +178,19 @@ class FlightRecorder:
         memdrift: Any = None,
         attribution: Any = None,
         health: Any = None,
+        chunk_stalls: Any = None,
+        chunk_stall_min: int = 3,
     ) -> List[str]:
         """Evaluate the trigger conditions; returns human-readable
-        reasons (empty list == nothing to dump)."""
+        reasons (empty list == nothing to dump).
+
+        ``chunk_stalls`` is a trailing window of ``decode.chunk_stalls``
+        counter samples (monotonic totals, e.g.
+        :meth:`chunk_stall_samples`): SUSTAINED growth — at least
+        ``chunk_stall_min`` new stalls accumulated across two or more
+        rising steps — means a chunked prefill is being starved of its
+        per-segment budget RIGHT NOW, the transient the ring exists to
+        capture."""
         reasons: List[str] = []
         if slo_report is not None and slo_report.exceeds():
             worst = slo_report.worst_breach()
@@ -208,7 +218,30 @@ class FlightRecorder:
                     f"health_breach: {f.code} {f.detector} "
                     f"{f.series} slope={slope}/s > {f.threshold:g}/s"
                 )
+        if chunk_stalls:
+            vals = [float(v) for v in chunk_stalls]
+            growth = vals[-1] - vals[0]
+            rising = sum(
+                1 for a, b in zip(vals, vals[1:]) if b > a
+            )
+            if growth >= chunk_stall_min and rising >= 2:
+                reasons.append(
+                    f"chunk_stall: +{growth:g} stalls over "
+                    f"{len(vals)} trailing samples"
+                )
         return reasons
+
+    def chunk_stall_samples(self, window: int = 32) -> List[float]:
+        """The trailing ``decode.chunk_stalls`` counter totals still in
+        the ring (the engine samples the counter into the tracer at
+        every stall) — feed these to :meth:`triggers`/:meth:`maybe_dump`
+        as ``chunk_stalls``."""
+        vals = [
+            float(ev["value"]) for ev in self.tracer.events
+            if ev["type"] == "counter"
+            and ev["name"] == "decode.chunk_stalls"
+        ]
+        return vals[-window:]
 
     # -- dumping -----------------------------------------------------------
     def dump(self, out_dir: str, reasons: List[str]) -> Dict[str, Any]:
@@ -244,10 +277,12 @@ class FlightRecorder:
         memdrift: Any = None,
         attribution: Any = None,
         health: Any = None,
+        chunk_stalls: Any = None,
     ) -> Optional[Dict[str, Any]]:
         """Dump iff a trigger fires; returns the dump record or None."""
         reasons = self.triggers(slo_report=slo_report, memdrift=memdrift,
-                                attribution=attribution, health=health)
+                                attribution=attribution, health=health,
+                                chunk_stalls=chunk_stalls)
         if not reasons:
             return None
         return self.dump(out_dir, reasons)

@@ -43,6 +43,7 @@ and summarized like ``dls.metrics/1``:
 
 from __future__ import annotations
 
+import re
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -68,7 +69,7 @@ class RequestRecord:
     __slots__ = (
         "rid", "prompt_len", "max_new_tokens", "state",
         "t_submit", "t_admit", "t_first_token", "t_retire", "t_preempt",
-        "n_tokens", "deliveries",
+        "n_tokens", "deliveries", "cause",
     )
 
     def __init__(self, rid: Any, prompt_len: int, max_new_tokens: int,
@@ -77,6 +78,9 @@ class RequestRecord:
         self.prompt_len = int(prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.state = "queued"
+        # terminal cause code for shed/defer/preempt outcomes (e.g.
+        # ``preempt_tier0_victim``); None for the ordinary lifecycle
+        self.cause: Optional[str] = None
         self.t_submit = t_submit
         self.t_admit: Optional[float] = None
         self.t_first_token: Optional[float] = None
@@ -135,6 +139,7 @@ class RequestRecord:
             "ttft_s": self.ttft_s,
             "tpot_s": self.tpot_s,
             "e2e_s": self.e2e_s,
+            "cause": self.cause,
         }
 
 
@@ -206,15 +211,19 @@ class RequestLog:
             rec.state = "retired"
             rec.t_retire = t
 
-    def preempt(self, rid: Any, t: float) -> None:
+    def preempt(self, rid: Any, t: float,
+                cause: Optional[str] = None) -> None:
         """Eviction seam: the request's pages went back to the pool and
         its generated prefix is re-queued by the serving layer under a
         NEW rid — this record is terminal (tokens it delivered stay
-        counted; TTFT evidence stays anchored at the first pass)."""
+        counted; TTFT evidence stays anchored at the first pass).
+        ``cause`` stamps WHY it was evicted (``preempt_tier0_victim``)."""
         rec = self._records.get(rid)
         if rec is not None:
             rec.state = "preempted"
             rec.t_preempt = t
+            if cause is not None:
+                rec.cause = cause
 
     def _evict(self) -> None:
         if self.capacity is None:
@@ -332,6 +341,14 @@ def validate_request_log(snap: Any) -> List[str]:
         state = row.get("state")
         if state not in STATES:
             errs.append(f"requests[{i}] unknown state {state!r}")
+        # ``cause`` is optional (rows from pre-cause snapshots omit it)
+        # but when present it must be a code string or null
+        if "cause" in row and row["cause"] is not None \
+                and not isinstance(row["cause"], str):
+            errs.append(
+                f"requests[{i}] cause is "
+                f"{type(row['cause']).__name__}, not str/null"
+            )
         for msg in timestamp_order_errors(row):
             errs.append(f"requests[{i}] {msg}")
         if row.get("state") == "retired":
@@ -371,17 +388,44 @@ def _percentiles(vals: List[float]) -> Dict[str, Optional[float]]:
     }
 
 
+_DERIVED_RID = re.compile(r"^(.*)#p(\d+)$")
+
+
+def stitch_logical_chains(
+    reqs: List[Dict[str, Any]],
+) -> Dict[str, List[Dict[str, Any]]]:
+    """Group rows into LOGICAL requests: a preempted pass and its
+    resumed derivatives (``{rid}#pk``) are one chain, ordered by pass
+    number.  Rows whose rid carries no suffix and spawned no
+    derivatives are singleton chains."""
+    chains: Dict[str, Dict[int, Dict[str, Any]]] = {}
+    for r in reqs:
+        rid = str(r.get("rid"))
+        m = _DERIVED_RID.match(rid)
+        base, k = (m.group(1), int(m.group(2))) if m else (rid, 0)
+        chains.setdefault(base, {})[k] = r
+    return {
+        base: [passes[k] for k in sorted(passes)]
+        for base, passes in chains.items()
+    }
+
+
 def summarize_request_log(snap: Any) -> Dict[str, Any]:
     """Counts + latency percentiles the ``slo`` CLI prints (and the CI
     smoke step asserts).  Accepts a ``snapshot()`` dict."""
     reqs = snap.get("requests", []) if isinstance(snap, dict) else []
     by_state: Dict[str, int] = {}
+    by_cause: Dict[str, int] = {}
     for r in reqs:
         by_state[r.get("state", "?")] = by_state.get(r.get("state", "?"), 0) + 1
+        cause = r.get("cause")
+        if cause:
+            by_cause[str(cause)] = by_cause.get(str(cause), 0) + 1
     retired = [r for r in reqs if r.get("state") == "retired"]
     out: Dict[str, Any] = {
         "n_requests": len(reqs),
         "by_state": dict(sorted(by_state.items())),
+        "by_cause": dict(sorted(by_cause.items())),
         "n_retired": len(retired),
         "tokens_delivered": sum(int(r.get("n_tokens", 0)) for r in reqs),
         "evicted": snap.get("evicted", 0) if isinstance(snap, dict) else 0,
@@ -392,6 +436,41 @@ def summarize_request_log(snap: Any) -> Dict[str, Any]:
             if r.get(metric) is not None
         ]
         out[metric] = _percentiles(vals)
+    # logical view: preempted+resumed derived-rid chains collapse to
+    # ONE request each; the preempt->re-admit holes are excluded from
+    # the logical TPOT (the engine was not generating then)
+    chains = stitch_logical_chains(reqs)
+    multi = {b: c for b, c in chains.items() if len(c) > 1}
+    pre_times: List[float] = []
+    tpots: List[float] = []
+    for passes in chains.values():
+        pre = 0.0
+        complete = True
+        for prev, nxt in zip(passes, passes[1:]):
+            tp, ta = prev.get("t_preempt"), nxt.get("t_admit")
+            if tp is None or ta is None:
+                complete = False
+                break
+            pre += float(ta) - float(tp)
+        if not complete:
+            continue
+        if len(passes) > 1:
+            pre_times.append(pre)
+        last = passes[-1]
+        n = sum(int(p.get("n_tokens", 0)) for p in passes)
+        t_ft = passes[0].get("t_first_token")
+        t_ret = last.get("t_retire")
+        if (last.get("state") == "retired" and t_ft is not None
+                and t_ret is not None and n > 1):
+            tpots.append(
+                (float(t_ret) - float(t_ft) - pre) / (n - 1)
+            )
+    out["logical"] = {
+        "n_logical": len(chains),
+        "n_chains": len(multi),
+        "preempted_time_s": _percentiles(pre_times),
+        "tpot_s": _percentiles(tpots),
+    }
     return out
 
 
@@ -400,6 +479,7 @@ __all__ = [
     "RequestRecord",
     "SCHEMA",
     "STATES",
+    "stitch_logical_chains",
     "summarize_request_log",
     "timestamp_order_errors",
     "validate_request_log",

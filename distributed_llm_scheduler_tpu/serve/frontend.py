@@ -106,7 +106,7 @@ class _Req:
     """One logical request's serving state across engine passes."""
 
     __slots__ = ("a", "cur_prompt", "cur_max_new", "prefix_parts",
-                 "preemptions", "state", "passes")
+                 "preemptions", "state", "passes", "cause")
 
     def __init__(self, a: Arrival, prompt_ids: np.ndarray):
         self.a = a
@@ -116,6 +116,10 @@ class _Req:
         self.preemptions = 0
         self.state = "waiting"                # waiting|inflight|shed|done
         self.passes: List[str] = []           # engine rids, in order
+        # terminal cause code (shed_deadline | shed_ttft_doomed |
+        # preempt_tier0_victim | defer_tier) — why the frontend last
+        # acted on this request, None for the untouched happy path
+        self.cause: Optional[str] = None
 
     @property
     def total_rows(self) -> int:
@@ -269,24 +273,44 @@ class ServingFrontend:
                 on_tick(self)
         return self.report()
 
+    def _reqtrace(self):
+        """The engine's per-request waterfall recorder, or None — the
+        same zero-overhead guard the engine hot paths use."""
+        return getattr(self.engine, "reqtrace", None)
+
     def _shed_remaining(self) -> None:
         """Deadline passed: drop arrivals that never happened and shed
         the backlog; in-flight work keeps draining."""
         self._pending.clear()
+        rt = self._reqtrace()
+        now = self.clock() if (self._backlog and rt is not None) else None
         for req in self._backlog:
             req.state = "shed"
+            req.cause = "shed_deadline"
+            if rt is not None:
+                rt.shed(req.a.rid, now, cause="shed_deadline")
         self._backlog.clear()
 
     def _tick(self) -> None:
         now = self.clock()
         rel = now - self.t0
         # 1. inject arrivals whose deadline has passed
+        rt = self._reqtrace()
         while self._pending and self._pending[0].t <= rel + 1e-9:
             a = self._pending.pop(0)
             req = _Req(a, self.prompt_fn(
                 a.rid, a.prompt_len, self.vocab_size, self.prompt_seed
             ))
             self._reqs[a.rid] = req
+            if rt is not None:
+                # waterfall anchor = ARRIVAL time, matching the serving
+                # row's t_submit; the engine's later submit() for the
+                # same rid is an idempotent no-op on this track
+                rt.submit(
+                    a.rid, self.t0 + a.t, prompt_len=a.prompt_len,
+                    max_new_tokens=a.max_new_tokens,
+                    priority=a.priority,
+                )
             if self.admission == "fifo":
                 self._submit_to_engine(req)   # admit-all: engine FIFO queues
             else:
@@ -357,6 +381,7 @@ class ServingFrontend:
 
         breaching = self._ttft_breaching(now)
         target = self.policy.ttft_s
+        rt = self._reqtrace()
         keep: List[_Req] = []
         for req in self._backlog:
             waited = now - (self.t0 + req.a.t)
@@ -365,6 +390,9 @@ class ServingFrontend:
                 # already blew its TTFT budget: zero possible goodput,
                 # so shed instead of spending pages on it
                 req.state = "shed"
+                req.cause = "shed_ttft_doomed"
+                if rt is not None:
+                    rt.shed(req.a.rid, now, cause="shed_ttft_doomed")
                 continue
             keep.append(req)
         self._backlog = keep
@@ -378,7 +406,11 @@ class ServingFrontend:
         sharing = bool(getattr(self.engine, "sharing", False))
         for req in order:
             if breaching and req.a.priority > 0 and not req.passes:
-                continue  # defer low tier while the TTFT window breaches
+                # defer low tier while the TTFT window breaches
+                req.cause = "defer_tier"
+                if rt is not None:
+                    rt.wait(req.a.rid, now, "defer_tier")
+                continue
             adm_need = getattr(
                 self.engine, "admission_pages_needed", None
             )
@@ -398,6 +430,18 @@ class ServingFrontend:
             else:
                 need = pages_needed(req.total_rows, self.engine.page_size)
             if free_slots < 1 or need > free_pages:
+                if rt is not None:
+                    # who is the capacity? the in-flight page holders
+                    # (pure occupancy read — the same surface the
+                    # admission arithmetic above already consumed)
+                    holders = sorted(
+                        self.engine.page_occupancy()["per_request"]
+                    )
+                    rt.wait(
+                        req.a.rid, now,
+                        "slots_full" if free_slots < 1 else "page_pool",
+                        by=holders,
+                    )
                 if not (self.preemption and req.a.priority == 0):
                     continue
                 got = self._try_preempt(req, need, free_slots, free_pages)
@@ -448,9 +492,12 @@ class ServingFrontend:
             return None
         for v in chosen:
             erid = v.engine_rid()
-            res = self.engine.preempt(erid)
+            res = self.engine.preempt(
+                erid, cause="preempt_tier0_victim", by=str(req.a.rid)
+            )
             del self._inflight[erid]
             v.record_preemption(res)
+            v.cause = "preempt_tier0_victim"
             self._backlog.append(v)
         return gs, gp
 
@@ -488,6 +535,7 @@ class ServingFrontend:
             "n_tokens": 0,
             "deliveries": [],
             "preemptions": req.preemptions,
+            "cause": req.cause,
         }
         if req.state == "shed":
             row["state"] = "shed"

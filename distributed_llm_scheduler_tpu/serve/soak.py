@@ -330,6 +330,14 @@ def run_soak(
             and health is not None and health.exceeds()):
         flight.maybe_dump(flight_dir, health=health)
 
+    # attribution runs over the full rows BEFORE they are stripped from
+    # the serving block (the soak artifact keeps per-request rows out of
+    # the summary; the bucket totals + aggressor ranking survive)
+    from ..obs.interference import attribute_requests
+
+    interference = attribute_requests(
+        report["requests"], ttft_target_s=cfg.ttft_s
+    ).summary(requests=False)
     serving = {k: v for k, v in report.items() if k != "requests"}
     art: Dict[str, Any] = {
         "schema": SCHEMA,
@@ -344,6 +352,7 @@ def run_soak(
         },
         "attention_impl": eng.summary()["attention_impl"],
         "serving": serving,
+        "interference": interference,
         "digest": fe.digest(),
         "flight_dumps": list(flight.dumps) if flight else [],
     }

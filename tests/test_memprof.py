@@ -309,40 +309,21 @@ def test_memprof_perfetto_counter_tracks(exec_setup, tmp_path):
 # Decode engine: KV page-pool folding
 
 
-def test_decode_page_pool_folds_into_memprof():
+def test_decode_page_pool_folds_into_memprof(session_slo_engine):
     """Page allocations at admission land in the kv_pages bucket in
-    whole-page units; retirement frees them back to zero."""
-    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
-        build_paged_decode_dag,
-    )
-    from distributed_llm_scheduler_tpu.models.kv_pages import (
-        PagePool,
-        pages_needed,
-    )
+    whole-page units; retirement frees them back to zero.
+
+    Rides the session-scoped slo engine (same 2-slot geometry this test
+    used to build from scratch): ``rebind_obs`` re-points the warm
+    executables at this test's scripted clock + profiler."""
+    from distributed_llm_scheduler_tpu.models.kv_pages import pages_needed
 
     cfg = GPT2Config.tiny()
-    slots, ps, n_pages, ppseq = 2, 8, 32, 4
-    dag = build_paged_decode_dag(
-        cfg, slots=slots, page_size=ps, n_pages=n_pages, pages_per_seq=ppseq
-    )
-    params = dag.init_params()
-    weights = {
-        k: v
-        for k, v in params.items()
-        if not (k.startswith("cache_") or k == "page_table")
-    }
-    cluster = Cluster.from_jax_devices(jax.devices()[:1])
-    backend = DeviceBackend(cluster)
-    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    pool = PagePool(n_pages=n_pages, page_size=ps)
-
+    eng = session_slo_engine
     clk = FakeClock(0.0)
     mem = MemoryProfiler(clock=clk)
-    eng = backend.paged_decode_engine(
-        dag.graph, sched, cfg, weights, pool,
-        slots=slots, pages_per_seq=ppseq, seg_steps=4,
-        clock=clk, memprof=mem,
-    )
+    eng.rebind_obs(clock=clk, memprof=mem)
+    ps = eng.pool.page_size
     page_bytes = (
         cfg.n_layer * 2 * ps * cfg.n_head * (cfg.n_embd // cfg.n_head)
         * np.dtype(cfg.dtype).itemsize
@@ -355,7 +336,7 @@ def test_decode_page_pool_folds_into_memprof():
     eng.submit("r1", prompt, max_new)
     clk.t = 1.0
     eng.step_segment()  # admits both
-    node = next(iter(sched.placement.values()))
+    node = next(iter(mem.devices()))
     need = pages_needed(prompt.shape[1] + max_new, ps)
     assert mem.live_bytes(node) == 2 * need * page_bytes
     wm_live = {

@@ -376,40 +376,18 @@ def test_schedule_trace_without_graph_has_no_flows(tmp_path):
 # Decode engine: TTFT / TPOT on a scripted clock
 
 
-def test_decode_engine_ttft_tpot_scripted_clock():
+def test_decode_engine_ttft_tpot_scripted_clock(session_slo_engine):
     """Submit at t=10/12, admit (prefill) at t=20, retire at t=24 after 9
-    tokens in total -> TTFT {10, 8} and TPOT (24-20)/8 = 0.5 exactly."""
-    from distributed_llm_scheduler_tpu.backends.device import DeviceBackend
-    from distributed_llm_scheduler_tpu.frontend.decode_dag import (
-        build_paged_decode_dag,
-    )
-    from distributed_llm_scheduler_tpu.models import gpt2
-    from distributed_llm_scheduler_tpu.models.kv_pages import PagePool
+    tokens in total -> TTFT {10, 8} and TPOT (24-20)/8 = 0.5 exactly.
 
-    cfg = gpt2.GPT2Config.tiny()
-    slots, ps, n_pages, ppseq = 2, 8, 32, 4
-    dag = build_paged_decode_dag(
-        cfg, slots=slots, page_size=ps, n_pages=n_pages, pages_per_seq=ppseq
-    )
-    params = dag.init_params()
-    weights = {
-        k: v
-        for k, v in params.items()
-        if not (k.startswith("cache_") or k == "page_table")
-    }
-    cluster = Cluster.from_jax_devices(jax.devices()[:1])
-    backend = DeviceBackend(cluster)
-    sched = get_scheduler("greedy").schedule(dag.graph, cluster)
-    pool = PagePool(n_pages=n_pages, page_size=ps)
-
+    Rides the session-scoped slo engine (same 2-slot geometry this test
+    used to build from scratch): ``rebind_obs`` points the warm
+    executables at this test's scripted clock/tracer/metrics."""
+    eng = session_slo_engine
     clk = FakeClock(0.0)
     tr = Tracer(clock=clk)
     reg = MetricsRegistry()
-    eng = backend.paged_decode_engine(
-        dag.graph, sched, cfg, weights, pool,
-        slots=slots, pages_per_seq=ppseq, seg_steps=4,
-        trace=tr, metrics=reg, clock=clk,
-    )
+    eng.rebind_obs(clock=clk, tracer=tr, metrics=reg)
 
     prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
     clk.t = 10.0
@@ -442,8 +420,9 @@ def test_decode_engine_ttft_tpot_scripted_clock():
     assert {e["args"]["rid"] for e in retires} == {"r0", "r1"}
     assert "decode.queue_depth" in tr.counter_names()
     assert "decode.page_pool_occupancy_pages" in tr.counter_names()
-    # engine returned every page (leak gauge wired in run(); check pool)
-    assert pool.free_pages == n_pages - 1
+    # engine returned every page (leak gauge wired in run(); check the
+    # pool AFTER the rebind — rebind_obs swaps in a pristine one)
+    assert eng.pool.free_pages == eng.pool.n_pages - 1
 
 
 # ---------------------------------------------------------------------------

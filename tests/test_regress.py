@@ -33,6 +33,7 @@ BASE = {
     "serve.goodput_tok_s": 200.0,
     "serve.ttft_p99_ms": 130.0,
     "serve.queue_wait_p95_ms": 120.0,
+    "serve.attribution.max_residual_s": 0.0,
     "serve.prefix.goodput_tok_s": 165.2,
     "serve.prefix.ttft_p99_ms": 167.6,
     "serve.prefix.goodput_gain": 1.6,
