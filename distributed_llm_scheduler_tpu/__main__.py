@@ -1738,7 +1738,12 @@ def cmd_doctor(args) -> int:
     aggressor→victim interference pairs, live (bare flag) or offline
     over a saved serve artifact / flight dump / request log.  Exit 1
     when a breaching request's dominant wait bucket exceeds
-    ``--dominant-threshold``, 2 malformed."""
+    ``--dominant-threshold``, 2 malformed.
+
+    ``--fleet`` switches to the FLEET doctor: the per-replica health
+    battery over a live chaos leg (bare flag) or a saved
+    ``dls.fleet/1`` artifact, exit 1 when any replica currently
+    breaches."""
     from .obs.attribution import attribute_run, attribute_trace
 
     if getattr(args, "memory", False):
@@ -1747,6 +1752,8 @@ def cmd_doctor(args) -> int:
         return _cmd_doctor_slo(args)
     if getattr(args, "soak", None):
         return _cmd_doctor_soak(args)
+    if getattr(args, "fleet", None):
+        return _cmd_doctor_fleet(args)
     if getattr(args, "serve", None):
         return _cmd_doctor_serve(args)
     if getattr(args, "requests", None):
@@ -1931,6 +1938,92 @@ def _cmd_doctor_soak(args) -> int:
             f"({report.warmup_s:g}s)", file=sys.stderr,
         )
         return 1
+    return 0
+
+
+def _cmd_doctor_fleet(args) -> int:
+    """The fleet doctor (``doctor --fleet [live|ART_JSON]``): gate a
+    replica fleet on the per-replica health battery.
+
+    ``live`` (the default when the flag is bare) runs the serve-bench
+    fleet chaos leg — N=3 replicas on the lockstep virtual clock, the
+    page leak injected on one, scored routing + the HLT001 battery —
+    and gates the resulting :class:`~.obs.fleet.FleetHealthReport`.  A
+    healed breach (drained, restarted, readmitted) lives in the event
+    history, not the current findings, so a fleet that failed over
+    cleanly exits 0.  A path re-gates a saved ``dls.fleet/1`` artifact
+    (or a bare ``dls.fleet-health/1`` block) offline.  Exit 2
+    malformed, 1 when any replica currently breaches, 0 healthy."""
+    from .obs.fleet import report_from_fleet_artifact
+
+    if args.fleet == "live":
+        from .eval import serve_bench
+        from .obs.fleet import fleet_detectors
+        from .obs.slo import SLOPolicy
+        from .serve.frontend import ServiceTimeModel
+        from .serve.loadgen import poisson_arrivals
+
+        sc = dict(serve_bench.SCENARIO, **serve_bench.FLEET_SCENARIO)
+        arrivals = poisson_arrivals(
+            sc["fleet_rate_rps"], sc["fleet_n_requests"], args.seed or 7,
+            prompt_lens=sc["prompt_lens"],
+            max_new_tokens=sc["max_new_tokens"],
+            priorities=sc["priorities"],
+            priority_weights=sc["priority_weights"],
+        )
+        policy = SLOPolicy(
+            ttft_s=sc["ttft_s"], window_s=sc["window_s"],
+            percentile=sc["percentile"],
+        )
+        tm = ServiceTimeModel(
+            wave_s=sc["wave_s"], segment_s=sc["segment_s"],
+            idle_s=sc["idle_s"],
+        )
+        leg = serve_bench.run_fleet_leg(
+            arrivals, policy, tm, sc, routing="score",
+            detectors=fleet_detectors(), leak=True,
+        )
+        obj = {"fleet_health": leg["fleet_health"]}
+        context = {
+            "mode": "live",
+            "goodput_tok_s": leg["goodput_tok_s"],
+            "drains": leg["drains"],
+            "restarts": leg["restarts"],
+            "migrations": leg["migrations"],
+            "pages_leaked": leg["pages_leaked"],
+        }
+    else:
+        try:
+            with open(args.fleet) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"doctor --fleet: {e}", file=sys.stderr)
+            return 2
+        context = {"mode": "offline", "path": args.fleet}
+        if isinstance(obj, dict):
+            context["schema"] = obj.get("schema")
+    try:
+        report = report_from_fleet_artifact(obj)
+    except ValueError as e:
+        print(f"doctor --fleet: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(
+        {"fleet": context, "fleet_health": report.to_json()},
+        indent=1,
+    ))
+    if report.exceeds():
+        rid, w = report.worst_breach() or report.breaches()[0]
+        print(
+            f"doctor: replica {rid}: {w.code} {w.detector}: {w.series} "
+            f"slope {w.slope:+.6g}/s exceeds {w.threshold:g}/s",
+            file=sys.stderr,
+        )
+        return 1
+    n = len(report.replicas)
+    print(
+        f"fleet: healthy — {n} replicas, {report.drains()} drains, "
+        f"{report.restarts()} restarts on record", file=sys.stderr,
+    )
     return 0
 
 
@@ -2701,6 +2794,15 @@ def main(argv=None) -> int:
                         "artifact offline — rebuild its timeseries and "
                         "re-run the leak/degradation detector battery "
                         "(exit 1 on breach, 2 malformed)")
+    p.add_argument("--fleet", nargs="?", const="live", default=None,
+                   metavar="FLEET_JSON",
+                   help="fleet doctor: gate the per-replica health "
+                        "battery — bare flag runs the fleet chaos leg "
+                        "live (leak injected, drain/restart must heal "
+                        "it); a path re-gates a saved dls.fleet/1 "
+                        "artifact or dls.fleet-health/1 block offline "
+                        "(exit 1 when any replica currently breaches, "
+                        "2 malformed)")
     p.add_argument("--serve", default=None, metavar="ART_JSON",
                    help="serving-safety doctor: re-gate a committed "
                         "dls.serve/1 or dls.soak/1 artifact offline "

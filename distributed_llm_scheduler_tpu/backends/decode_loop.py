@@ -387,6 +387,11 @@ class PagedDecodeEngine:
         # page) until the last chunk folds.
         self._chunk_state: Dict[int, Dict[str, Any]] = {}
         self._chunk_rr = 0
+        # drain seam (fleet failover): while set, submit() hard-rejects
+        # new work — already-queued and in-flight requests keep running
+        # to completion, which is what lets a sick replica empty itself
+        # before a restart.  Cleared by reset()/rebind_obs().
+        self._draining = False
         # virtual-time seam: when set, called with the REAL token count
         # right before every prefill dispatch (whole wave, stitched
         # tail, or chunk) so a VirtualClock frontend can charge prefill
@@ -561,6 +566,7 @@ class PagedDecodeEngine:
         self._first_tok_t = {}
         self._chunk_state = {}
         self._chunk_rr = 0
+        self._draining = False
         # fresh request log per run (benches reset between reps); the
         # flight ring deliberately survives — it is the always-on
         # last-N record across runs
@@ -804,6 +810,31 @@ class PagedDecodeEngine:
         if self.tracer is not None:
             self.tracer.counter("decode.queue_depth", depth)
 
+    # -- drain (fleet failover) --------------------------------------------
+    @property
+    def draining(self) -> bool:
+        """True while the engine rejects new submissions (fleet drain)."""
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop accepting new work: ``submit()`` raises until the drain
+        ends.  Queued and in-flight requests are commitments — they keep
+        admitting and decoding to completion, so a draining engine
+        empties itself instead of wedging its queue.  Idempotent."""
+        if not self._draining:
+            self._draining = True
+            self.metrics.counter("decode.drains_begun").inc()
+            if self.tracer is not None:
+                self.tracer.instant(
+                    "drain_begin", track="decode", cat="decode",
+                    t=self._clock(),
+                )
+
+    def end_drain(self) -> None:
+        """Re-open submission without a restart (``reset()`` and
+        ``rebind_obs()`` also clear the drain flag)."""
+        self._draining = False
+
     # -- pool headroom (ONE surface) ---------------------------------------
     @property
     def free_slots(self) -> int:
@@ -881,6 +912,8 @@ class PagedDecodeEngine:
         if self.chunk_tokens is not None:
             out["chunk_tokens"] = self.chunk_tokens
             out["prefilling"] = len(self._chunk_state)
+        if self._draining:
+            out["draining"] = True
         return out
 
     def submit(self, rid: Any, prompt_ids: Any, max_new_tokens: int) -> None:
@@ -892,6 +925,10 @@ class PagedDecodeEngine:
         collide lifecycle-log rows, so it is a hard error.  A PREEMPTED
         rid is also spent — the serving layer re-queues the generated
         prefix under a derived rid (``reset()`` clears everything)."""
+        if self._draining:
+            raise RuntimeError(
+                f"engine is draining: rejecting submit of rid {rid!r}"
+            )
         if rid in self.results:
             raise ValueError(f"duplicate rid {rid!r}: already retired")
         if rid in self._tokens:

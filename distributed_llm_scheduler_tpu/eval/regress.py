@@ -57,6 +57,14 @@ LOWER_BETTER = (
     "soak.ttft_p95_slope_s_per_s",
     "soak.queue_wait_p95_slope_s_per_s",
     "soak.throughput_decay_tok_s2",
+    # fleet failover legs: drain/restart counts and residual leaks are
+    # deterministic virtual-time outcomes — fewer is better, and the
+    # healthy (no-injection) leg must stay at exactly zero
+    "fleet.drains",
+    "fleet.restarts",
+    "fleet.migrations",
+    "fleet.pages_leaked",
+    "fleet.healthy_drains",
     # paged decode legs: any leaked page is an engine bug
     "decode.pages_leaked",
     "decode.kernel_pages_leaked",
@@ -121,6 +129,10 @@ METRIC_DEFAULT_TOLERANCES = {
     # makespans, and margins are pure functions of (seed, budget), so
     # any drift is a behavior change, not noise (family-wide)
     "search": 0.0,
+    # fleet legs run every replica on the lockstep VirtualClock: routing
+    # decisions, drain/restart counts, and goodput are pure functions of
+    # the seed, so the whole family is exact-match (family-wide)
+    "fleet": 0.0,
 }
 HIGHER_BETTER = (
     "vs_baseline",
@@ -134,6 +146,8 @@ HIGHER_BETTER = (
     "serve.chunked.goodput_tok_s",
     "serve.chunked.tpot_p99_gain",
     "soak.goodput_tok_s",
+    "fleet.goodput_tok_s",
+    "fleet.goodput_gain_vs_rr",
     "decode.paged_tok_s",
     "decode.paged_speedup",
     "decode.kernel_vs_gather_speedup",
@@ -147,6 +161,7 @@ BOOL_METRICS = (
     "decode.paged_tokens_exact",
     "decode.kernel_tokens_exact",
     "decode.kernel_parity_ok",
+    "fleet.deterministic",
     "search.beats_hand",
     "search.beats_ici_extreme",
 )
@@ -181,6 +196,13 @@ DEFAULT_METRICS = (
     "serve.chunked.token_parity",
     "serve.chunked.pages_leaked",
     "serve.attribution.max_residual_s",
+    "fleet.goodput_tok_s",
+    "fleet.goodput_gain_vs_rr",
+    "fleet.drains",
+    "fleet.restarts",
+    "fleet.pages_leaked",
+    "fleet.healthy_drains",
+    "fleet.deterministic",
     "decode.paged_tokens_exact",
     "decode.pages_leaked",
     "decode.kernel_tokens_exact",

@@ -76,6 +76,13 @@ from .health import (
     default_detectors,
     report_from_soak_artifact,
 )
+from .fleet import (
+    FleetHealthReport,
+    fleet_detectors,
+    merge_snapshots,
+    report_from_fleet_artifact,
+    validate_fleet_health,
+)
 from .memdrift import MemDriftReport, compute_mem_drift
 from .memprof import MemoryProfiler
 from .metrics import MetricsRegistry
@@ -163,6 +170,7 @@ __all__ = [
     "Clock",
     "Detector",
     "DriftReport",
+    "FleetHealthReport",
     "FlightRecorder",
     "HOST_TRACK",
     "HealthFinding",
@@ -196,9 +204,13 @@ __all__ = [
     "default_detectors",
     "evaluate_slo",
     "events_from_perfetto",
+    "fleet_detectors",
     "flight_enabled",
     "load_timeseries",
+    "merge_snapshots",
+    "report_from_fleet_artifact",
     "report_from_soak_artifact",
+    "validate_fleet_health",
     "request_track",
     "reset_ambient",
     "resolve_clock",

@@ -123,26 +123,43 @@ class Histogram:
 
 class MetricsRegistry:
     """Get-or-create registry; re-requesting a name returns the same
-    instrument (the first declared unit wins)."""
+    instrument (the first declared unit wins).
 
-    def __init__(self) -> None:
+    ``prefix`` namespaces every instrument at creation (``n0.`` turns
+    ``decode.ttft_s`` into ``n0.decode.ttft_s``), and ``replica`` stamps
+    the snapshot with the replica id — together they are what lets N
+    per-replica registries merge into one fleet aggregate without key
+    collisions (two bare engines' ``decode.*`` keys would otherwise
+    silently collide).  Both default off, so existing snapshots stay
+    byte-identical."""
+
+    def __init__(self, prefix: str = "",
+                 replica: Optional[str] = None) -> None:
+        self.prefix = str(prefix)
+        self.replica = replica
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._hists: Dict[str, Histogram] = {}
 
+    def _name(self, name: str) -> str:
+        return self.prefix + name if self.prefix else name
+
     def counter(self, name: str, unit: Optional[str] = None) -> Counter:
+        name = self._name(name)
         c = self._counters.get(name)
         if c is None:
             c = self._counters[name] = Counter(unit)
         return c
 
     def gauge(self, name: str, unit: Optional[str] = None) -> Gauge:
+        name = self._name(name)
         g = self._gauges.get(name)
         if g is None:
             g = self._gauges[name] = Gauge(unit)
         return g
 
     def histogram(self, name: str, unit: Optional[str] = None) -> Histogram:
+        name = self._name(name)
         h = self._hists.get(name)
         if h is None:
             # name-derived seed: deterministic across runs, distinct
@@ -153,8 +170,10 @@ class MetricsRegistry:
         return h
 
     def snapshot(self) -> Dict[str, Any]:
-        """Stable JSON-ready view (see module docstring for the schema)."""
-        return {
+        """Stable JSON-ready view (see module docstring for the schema).
+        ``replica`` appears only when the registry was built with one —
+        unlabeled snapshots stay byte-identical to the pre-fleet form."""
+        out: Dict[str, Any] = {
             "schema": SCHEMA,
             "counters": {
                 n: {"value": c.value, "unit": c.unit}
@@ -179,6 +198,9 @@ class MetricsRegistry:
                 for n, h in sorted(self._hists.items())
             },
         }
+        if self.replica is not None:
+            out["replica"] = str(self.replica)
+        return out
 
 
 def validate_snapshot(snap: Any) -> List[str]:
@@ -190,6 +212,14 @@ def validate_snapshot(snap: Any) -> List[str]:
         return [f"snapshot is {type(snap).__name__}, not dict"]
     if snap.get("schema") != SCHEMA:
         errs.append(f"schema is {snap.get('schema')!r}, want {SCHEMA!r}")
+    # optional replica label (per-replica registries in a fleet); when
+    # present it must be a non-empty string
+    if "replica" in snap and (
+        not isinstance(snap["replica"], str) or not snap["replica"]
+    ):
+        errs.append(
+            f"replica is {snap['replica']!r}, want a non-empty string"
+        )
     for family, fields in (
         ("counters", ("value", "unit")),
         ("gauges", ("value", "max", "unit")),
@@ -231,6 +261,10 @@ def diff_snapshots(a: Any, b: Any) -> Dict[str, Any]:
             )
 
     out: Dict[str, Any] = {"schema": "dls.metrics-diff/1"}
+    # replica labels ride along so a cross-replica diff names its sides
+    if "replica" in a or "replica" in b:
+        out["replica_a"] = a.get("replica")
+        out["replica_b"] = b.get("replica")
     for family, keys in (
         ("counters", ("value",)),
         ("gauges", ("value", "max")),

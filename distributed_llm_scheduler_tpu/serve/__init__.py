@@ -1,10 +1,14 @@
 """Online serving layer: open-loop load generation (:mod:`.loadgen`),
 the event-loop front-end with SLO-aware admission and priority
 preemption (:mod:`.frontend`) over the paged continuous-batching
-decode engine, and the duration-bounded soak harness with health
-gating (:mod:`.soak`).  See ``docs/SERVING.md``."""
+decode engine, the duration-bounded soak harness with health gating
+(:mod:`.soak`), and the fleet tier — the replica registry
+(:mod:`.registry`) and the health-driven router with drain/failover
+(:mod:`.router`).  See ``docs/SERVING.md``."""
 
 from .frontend import ServiceTimeModel, ServingFrontend, VirtualClock
+from .registry import EngineRegistry, ReplicaHandle
+from .router import DuplicateRidError, FleetFrontend
 from .soak import (
     SoakConfig,
     inject_jit_churn,
@@ -30,6 +34,10 @@ from .loadgen import (
 
 __all__ = [
     "Arrival",
+    "DuplicateRidError",
+    "EngineRegistry",
+    "FleetFrontend",
+    "ReplicaHandle",
     "ServiceTimeModel",
     "ServingFrontend",
     "TRACE_SCHEMA",

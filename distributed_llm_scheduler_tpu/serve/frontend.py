@@ -298,9 +298,7 @@ class ServingFrontend:
         rt = self._reqtrace()
         while self._pending and self._pending[0].t <= rel + 1e-9:
             a = self._pending.pop(0)
-            req = _Req(a, self.prompt_fn(
-                a.rid, a.prompt_len, self.vocab_size, self.prompt_seed
-            ))
+            req = self._make_req(a)
             self._reqs[a.rid] = req
             if rt is not None:
                 # waterfall anchor = ARRIVAL time, matching the serving
@@ -363,6 +361,13 @@ class ServingFrontend:
                 if self._pending:
                     wait = max(self._pending[0].t - rel, 0.0005)
                 self._sleep(min(wait, 0.05))
+
+    def _make_req(self, a: Arrival) -> _Req:
+        """Materialize the serving state for a just-injected arrival
+        (the fleet router's subclass swaps in a migration-aware type)."""
+        return _Req(a, self.prompt_fn(
+            a.rid, a.prompt_len, self.vocab_size, self.prompt_seed
+        ))
 
     # -- admission / preemption -------------------------------------------
     def _submit_to_engine(self, req: _Req) -> None:
@@ -520,6 +525,15 @@ class ServingFrontend:
         )
 
     # -- the serving log ---------------------------------------------------
+    def _pass_records(self, req: _Req) -> List[Any]:
+        """Lifecycle records for each engine pass of ``req``, in pass
+        order (the fleet subclass also consults records frozen before a
+        replica restart wiped its log)."""
+        return [
+            r for r in (self.engine.reqlog.get(e) for e in req.passes)
+            if r is not None
+        ]
+
     def _row(self, req: _Req) -> Dict[str, Any]:
         t_arr = (self.t0 or 0.0) + req.a.t
         row: Dict[str, Any] = {
@@ -540,10 +554,7 @@ class ServingFrontend:
         if req.state == "shed":
             row["state"] = "shed"
         else:
-            recs = [
-                r for r in (self.engine.reqlog.get(e) for e in req.passes)
-                if r is not None
-            ]
+            recs = self._pass_records(req)
             if recs:
                 row["t_admit"] = recs[0].t_admit
                 row["t_first_token"] = recs[0].t_first_token

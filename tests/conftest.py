@@ -108,6 +108,41 @@ def session_slo_engine():
 
 
 @pytest.fixture(scope="session")
+def session_fleet_engines(session_serve_engine):
+    """Three compiled SCENARIO-geometry engines for the fleet tests
+    (replica ids ``n0``..``n2``): the shared session serve engine plus
+    two more builds — the only extra XLA compilations the fleet tier
+    costs the whole suite.  Tests re-register them through
+    ``EngineRegistry``, whose factory ``rebind_obs``-es each onto a
+    per-replica clock + replica-prefixed metrics (swapping in a
+    pristine ``PagePool``), so every test starts clean on warm
+    executables."""
+    from distributed_llm_scheduler_tpu.eval import serve_bench
+    from distributed_llm_scheduler_tpu.serve.frontend import VirtualClock
+
+    engines = {"n0": session_serve_engine}
+    for rid in ("n1", "n2"):
+        eng, _pool = serve_bench.build_serve_engine(clock=VirtualClock())
+        engines[rid] = eng
+    return engines
+
+
+@pytest.fixture(scope="session")
+def fleet_engine_factory(session_fleet_engines):
+    """``EngineRegistry(factory=...)``-shaped seam over the pooled
+    fleet engines: rebinds obs per replica per test, no fresh XLA
+    builds.  Replica ids beyond the pool raise KeyError — fleet tests
+    stay within N<=3."""
+
+    def factory(rid, *, clock=None, metrics=None):
+        eng = session_fleet_engines[rid]
+        eng.rebind_obs(clock=clock, metrics=metrics)
+        return eng
+
+    return factory
+
+
+@pytest.fixture(scope="session")
 def serve_engine_factory(session_serve_engine):
     """``run_soak(engine_factory=...)``-shaped seam over the session
     engine: rebinds obs per leg; a non-default attention impl changes

@@ -171,6 +171,37 @@ def test_soak_artifact_schema():
         assert stamps == sorted(stamps), (path, name)
 
 
+def test_fleet_artifact_schema():
+    d, path = _latest("FLEET")
+    from distributed_llm_scheduler_tpu.eval.serve_bench import (
+        fleet_gate_failures,
+        validate_fleet_artifact,
+    )
+    from distributed_llm_scheduler_tpu.obs.fleet import (
+        report_from_fleet_artifact,
+        validate_fleet_health,
+    )
+
+    assert validate_fleet_artifact(d) == [], path
+    # the r20 gates: health-driven routing strictly beats health-blind
+    # round-robin under the same injected leak, failover fired (one
+    # drain, exactly one restart, HLT001 named in the breach history)
+    # yet the fleet ENDS healthy, zero leaked pages on either gated
+    # leg, zero false-positive drains on the no-injection leg, and the
+    # same-seed repeat digested identically
+    assert fleet_gate_failures(d) == [], path
+    assert validate_fleet_health(d["fleet_health"]) == [], path
+    report = report_from_fleet_artifact(d)
+    assert not report.exceeds(), path
+    assert report.restarts() == 1, path
+    rr = d["legs"]["rr_blind"]
+    health = d["legs"]["health"]
+    assert health["goodput_tok_s"] > rr["goodput_tok_s"], path
+    assert d["fleet.pages_leaked"] == 0, path
+    assert d["fleet.healthy_drains"] == 0, path
+    assert d["fleet.deterministic"] is True, path
+
+
 def test_artifact_obs_metrics_blocks_validate():
     """Any artifact leg captured under DLS_TRACE=1 carries an
     ``obs_metrics`` snapshot (added r7); when present it must satisfy the

@@ -1,0 +1,317 @@
+"""Fleet tier tests: registry obs namespaces and restart semantics,
+N=1 digest parity with the standalone frontend, the drain/migrate/
+restart/probation state machine under an injected leak (bitwise token
+parity for migrated requests, LCY-clean merged rows, zero leaked
+pages), global duplicate-rid enforcement, merged-snapshot collision
+errors, the engine-level drain guard, and the ``doctor --fleet`` CLI
+exit-code contract (0 healthy / 1 breach / 2 malformed)."""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_llm_scheduler_tpu.eval import serve_bench  # noqa: E402
+from distributed_llm_scheduler_tpu.obs.fleet import (  # noqa: E402
+    FleetHealthReport,
+    fleet_detectors,
+    merge_snapshots,
+    report_from_fleet_artifact,
+    validate_fleet_health,
+)
+from distributed_llm_scheduler_tpu.obs.metrics import (  # noqa: E402
+    MetricsRegistry,
+)
+from distributed_llm_scheduler_tpu.obs.slo import SLOPolicy  # noqa: E402
+from distributed_llm_scheduler_tpu.serve.frontend import (  # noqa: E402
+    ServiceTimeModel,
+    ServingFrontend,
+    VirtualClock,
+)
+from distributed_llm_scheduler_tpu.serve.loadgen import (  # noqa: E402
+    Arrival,
+    poisson_arrivals,
+    prompt_token_ids,
+)
+from distributed_llm_scheduler_tpu.serve.registry import (  # noqa: E402
+    EngineRegistry,
+)
+from distributed_llm_scheduler_tpu.serve.router import (  # noqa: E402
+    DuplicateRidError,
+    FleetFrontend,
+)
+from distributed_llm_scheduler_tpu.serve.soak import (  # noqa: E402
+    inject_page_leak,
+)
+
+SC = serve_bench.SCENARIO
+
+# the chaos scenario the state-machine/migration tests share: 8-token
+# prompts with long decode budgets (8 + 24 = 32 rows exactly fills a
+# slot's page quota) keep requests in decode across many segments, so
+# the HLT001 breach on the leaky replica fires while it still holds
+# eligible in-flight work — the drain must preempt-MIGRATE, not just
+# re-route backlog
+CHAOS = {
+    "seed": 7,
+    "n_requests": 48,
+    "rate_rps": 30.0,
+    "prompt_lens": (8,),
+    "max_new_tokens": (16, 24),
+    "warmup_s": 0.1,
+    "sample_every_s": 0.02,
+    "probation_s": 0.3,
+    "deadline_s": 10.0,
+}
+
+
+def _policy():
+    return SLOPolicy(ttft_s=SC["ttft_s"], window_s=SC["window_s"],
+                     percentile=SC["percentile"])
+
+
+def _tm():
+    return ServiceTimeModel(wave_s=SC["wave_s"], segment_s=SC["segment_s"],
+                            idle_s=SC["idle_s"])
+
+
+def _registry(factory, n=3):
+    reg = EngineRegistry(factory)
+    for i in range(n):
+        reg.add(f"n{i}")
+    return reg
+
+
+def _scenario_arrivals(seed=7, n=None, rate=None):
+    return poisson_arrivals(
+        rate or SC["rate_rps"], n or SC["n_requests"], seed,
+        prompt_lens=SC["prompt_lens"],
+        max_new_tokens=SC["max_new_tokens"],
+        priorities=SC["priorities"],
+        priority_weights=SC["priority_weights"],
+    )
+
+
+# -- the shared chaos run (one fleet serve; several tests read it) ---------
+@pytest.fixture(scope="module")
+def chaos(fleet_engine_factory):
+    arrivals = poisson_arrivals(
+        CHAOS["rate_rps"], CHAOS["n_requests"], CHAOS["seed"],
+        prompt_lens=CHAOS["prompt_lens"],
+        max_new_tokens=CHAOS["max_new_tokens"],
+        priorities=SC["priorities"],
+        priority_weights=SC["priority_weights"],
+    )
+    reg = _registry(fleet_engine_factory)
+    inject_page_leak(reg.get("n0").engine, every=1)
+    fleet = FleetFrontend(
+        reg, arrivals, _policy(), admission="slo", preemption=True,
+        time_model=_tm(), routing="score", detectors=fleet_detectors(),
+        warmup_s=CHAOS["warmup_s"],
+        sample_every_s=CHAOS["sample_every_s"],
+        probation_s=CHAOS["probation_s"],
+    )
+    report = fleet.run(deadline=CHAOS["deadline_s"])
+    # snapshot everything row-derived NOW: later tests rebind the pooled
+    # engines, which wipes the live request logs these views read
+    return {
+        "arrivals": arrivals,
+        "fleet": fleet,
+        "report": report,
+        "rows": report["requests"],
+        "results": {k: np.asarray(v) for k, v in fleet.results.items()},
+        "lint": fleet.lint(),
+        "history": list(fleet.history),
+        "passes": {
+            rid: list(req.passes)
+            for fe in fleet._fes.values()
+            for rid, req in fe._reqs.items()
+        },
+    }
+
+
+def test_chaos_drain_restart_state_machine(chaos):
+    rep = chaos["report"]
+    assert rep["drains"] == 1
+    assert rep["restarts"] == 1
+    events = [(e["event"], e["replica"]) for e in chaos["history"]]
+    n0 = [ev for ev, rid in events if rid == "n0"]
+    # breach -> drain -> (migrations) -> restart -> readmit, in order
+    order = [ev for ev in n0 if ev in
+             ("breach", "drain", "restart", "readmit")]
+    assert order == ["breach", "drain", "restart", "readmit"]
+    breach = next(e for e in chaos["history"] if e["event"] == "breach")
+    assert "HLT001" in breach["detail"]
+    # healed: the handle is serving again and nothing currently breaches
+    h = chaos["fleet"].registry.get("n0")
+    assert h.state == "active"
+    assert h.restarts == 1
+    assert not h.engine.draining
+    assert rep["fleet_health"]["exceeds"] is False
+
+
+def test_chaos_zero_leaked_pages_and_lint_clean(chaos):
+    assert chaos["report"]["pages_leaked"] == 0
+    assert chaos["lint"].errors == []
+
+
+def test_chaos_migration_bitwise_token_parity(chaos, session_fleet_engines):
+    rows = {r["rid"]: r for r in chaos["rows"]}
+    migrated = [r for r in chaos["rows"] if r.get("migrations")]
+    assert migrated, "chaos scenario must preempt-migrate in-flight work"
+    done = [r for r in migrated if r["state"] == "retired"]
+    assert done, "at least one migrated request must finish"
+    by_rid = {a.rid: a for a in chaos["arrivals"]}
+    # derived pass rids advance #m on the hop
+    for r in migrated:
+        assert any(f"{r['rid']}#m1" in p for p in chaos["passes"][r["rid"]])
+    # an uninterrupted run of the same prompt on a pristine engine must
+    # produce the identical token series (greedy decode + stitched
+    # prefix == bitwise continuation across the hop)
+    eng = session_fleet_engines["n2"]
+    eng.rebind_obs(clock=VirtualClock())
+    vocab = int(eng.config.vocab_size)
+    for r in done:
+        a = by_rid[r["rid"]]
+        prompt = prompt_token_ids(a.rid, a.prompt_len, vocab, 0)
+        eng.submit(a.rid, prompt, a.max_new_tokens)
+        while a.rid not in eng.results:
+            eng.step_segment()
+        ref = np.asarray(eng.results[a.rid], np.int32)
+        np.testing.assert_array_equal(chaos["results"][r["rid"]], ref)
+    assert rows[done[0]["rid"]]["n_tokens"] == len(
+        chaos["results"][done[0]["rid"]]
+    )
+
+
+def test_chaos_fleet_health_report_roundtrip(chaos):
+    health = chaos["report"]["fleet_health"]
+    assert validate_fleet_health(health) == []
+    rt = FleetHealthReport.from_json(health)
+    assert rt.to_json() == health
+    assert not rt.exceeds()
+    assert rt.restarts() == 1 and rt.drains() == 1
+    # a full dls.fleet/1-shaped artifact re-gates through the same path
+    rep = report_from_fleet_artifact({"fleet_health": health})
+    assert not rep.exceeds()
+
+
+def test_chaos_duplicate_rid_after_migration(chaos):
+    fleet = chaos["fleet"]
+    migrated = next(r for r in chaos["rows"] if r.get("migrations"))
+    # the logical rid is spent fleet-wide even though it hopped replicas
+    with pytest.raises(DuplicateRidError):
+        fleet.submit(Arrival(rid=migrated["rid"], t=99.0,
+                             prompt_len=8, max_new_tokens=4))
+
+
+def test_duplicate_rid_at_construction(fleet_engine_factory):
+    reg = _registry(fleet_engine_factory, n=1)
+    dup = [Arrival(rid="r0", t=0.0, prompt_len=8, max_new_tokens=4),
+           Arrival(rid="r0", t=0.5, prompt_len=8, max_new_tokens=4)]
+    with pytest.raises(DuplicateRidError):
+        FleetFrontend(reg, dup, _policy(), time_model=_tm())
+
+
+def test_n1_detectorless_fleet_digest_matches_standalone(
+        fleet_engine_factory, session_fleet_engines):
+    arrivals = _scenario_arrivals()
+    reg = _registry(fleet_engine_factory, n=1)
+    fleet = FleetFrontend(
+        reg, arrivals, _policy(), admission="slo", preemption=True,
+        time_model=_tm(),
+    )
+    fleet.run()
+    fleet_digest = fleet.digest()
+    fleet_rows = fleet.request_rows()
+    # no fleet-only row fields on the unmigrated path
+    assert all("migrations" not in r for r in fleet_rows)
+
+    eng = session_fleet_engines["n0"]
+    eng.rebind_obs(clock=VirtualClock())
+    fe = ServingFrontend(
+        eng, arrivals, _policy(), admission="slo", preemption=True,
+        time_model=_tm(),
+    )
+    fe.run()
+    assert fe.digest() == fleet_digest
+    assert fe.request_rows() == fleet_rows
+
+
+def test_registry_namespaces_and_restart(fleet_engine_factory):
+    reg = _registry(fleet_engine_factory, n=2)
+    with pytest.raises(ValueError, match="duplicate replica id"):
+        reg.add("n0")
+    with pytest.raises(KeyError):
+        reg.get("n9")
+    h = reg.get("n0")
+    h.metrics.counter("decode.tokens_delivered")
+    snap = h.metrics.snapshot()
+    assert "n0.decode.tokens_delivered" in snap["counters"]
+    assert snap["replica"] == "n0"
+    assert h.engine.metrics is h.metrics
+    old_metrics, old_store = h.metrics, h.store
+    h.clock.advance(3.0)
+    h.engine.begin_drain()
+    reg.restart("n0")
+    assert h.restarts == 1
+    assert h.epoch_t0 == pytest.approx(3.0)
+    assert h.metrics is not old_metrics and h.store is not old_store
+    assert not h.engine.draining
+    # merged view: one dls.metrics/1 snapshot, both replica labels
+    merged = reg.merged_metrics()
+    assert merged["schema"] == "dls.metrics/1"
+    assert merged["replicas"] == ["n0", "n1"]
+
+
+def test_merge_snapshots_rejects_collisions():
+    a = MetricsRegistry(prefix="n0.", replica="n0")
+    b = MetricsRegistry(prefix="n0.", replica="n1")
+    a.counter("x").inc()
+    b.counter("x").inc()
+    with pytest.raises(ValueError, match="n0"):
+        merge_snapshots([a.snapshot(), b.snapshot()])
+    # unlabeled snapshots cannot merge at all
+    with pytest.raises(ValueError):
+        merge_snapshots([MetricsRegistry().snapshot()])
+
+
+def test_engine_drain_guard(session_fleet_engines):
+    eng = session_fleet_engines["n1"]
+    eng.rebind_obs(clock=VirtualClock())
+    vocab = int(eng.config.vocab_size)
+    eng.begin_drain()
+    assert eng.draining
+    assert eng.summary()["draining"] is True
+    with pytest.raises(RuntimeError, match="draining"):
+        eng.submit("rX", prompt_token_ids("rX", 8, vocab, 0), 4)
+    eng.end_drain()
+    eng.submit("rX", prompt_token_ids("rX", 8, vocab, 0), 4)
+    while "rX" not in eng.results:
+        eng.step_segment()
+    assert len(eng.results["rX"]) == 4
+
+
+def test_doctor_fleet_cli_exit_codes(tmp_path, chaos):
+    from distributed_llm_scheduler_tpu.__main__ import main
+
+    health = chaos["report"]["fleet_health"]
+    ok = tmp_path / "fleet_ok.json"
+    ok.write_text(json.dumps({"schema": "dls.fleet/1",
+                              "fleet_health": health}))
+    assert main(["doctor", "--fleet", str(ok)]) == 0
+
+    sick = json.loads(json.dumps(health))
+    finding = dict(sick["replicas"]["n0"]["findings"][0])
+    finding.update(severity="error", slope=1.0, threshold=0.05)
+    sick["replicas"]["n0"]["findings"] = [finding]
+    bad = tmp_path / "fleet_bad.json"
+    bad.write_text(json.dumps(sick))
+    assert main(["doctor", "--fleet", str(bad)]) == 1
+
+    junk = tmp_path / "junk.json"
+    junk.write_text("{\"schema\": \"dls.fleet/1\"}")
+    assert main(["doctor", "--fleet", str(junk)]) == 2
+    assert main(["doctor", "--fleet", str(tmp_path / "missing.json")]) == 2

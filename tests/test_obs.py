@@ -212,6 +212,42 @@ def test_histogram_reservoir_is_deterministic_per_name():
     assert hc._samples != ha._samples
 
 
+def test_metrics_prefix_namespaces_every_instrument():
+    reg = MetricsRegistry(prefix="n0.", replica="n0")
+    reg.counter("decode.tokens_delivered").inc(7)
+    reg.gauge("pool.used_pages").set(3)
+    reg.histogram("decode.ttft_s").observe(0.1)
+    snap = reg.snapshot()
+    assert validate_snapshot(snap) == []
+    assert snap["replica"] == "n0"
+    assert set(snap["counters"]) == {"n0.decode.tokens_delivered"}
+    assert set(snap["gauges"]) == {"n0.pool.used_pages"}
+    assert set(snap["histograms"]) == {"n0.decode.ttft_s"}
+    # get-or-create resolves the same instrument through the prefix
+    assert reg.counter("decode.tokens_delivered").value == 7
+    # an unlabeled registry's snapshot stays byte-identical to pre-fleet
+    bare = MetricsRegistry().snapshot()
+    assert "replica" not in bare
+    # the label is contractual when present: non-empty string only
+    assert validate_snapshot(dict(snap, replica="")) != []
+    assert validate_snapshot(dict(snap, replica=3)) != []
+
+
+def test_diff_snapshots_carries_replica_labels():
+    a = MetricsRegistry(prefix="n0.", replica="n0")
+    b = MetricsRegistry(prefix="n0.", replica="n1")
+    a.counter("tok").inc(2)
+    b.counter("tok").inc(5)
+    d = diff_snapshots(a.snapshot(), b.snapshot())
+    assert d["replica_a"] == "n0" and d["replica_b"] == "n1"
+    assert d["counters"]["n0.tok"]["value_delta"] == 3
+    # unlabeled diffs stay label-free
+    bare = diff_snapshots(
+        MetricsRegistry().snapshot(), MetricsRegistry().snapshot()
+    )
+    assert "replica_a" not in bare
+
+
 def test_diff_snapshots_tracks_p99():
     reg = MetricsRegistry()
     h = reg.histogram("lat")

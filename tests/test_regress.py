@@ -45,6 +45,13 @@ BASE = {
     "serve.chunked.tpot_p99_gain": 1.41,
     "serve.chunked.token_parity": True,
     "serve.chunked.pages_leaked": 0,
+    "fleet.goodput_tok_s": 335.5,
+    "fleet.goodput_gain_vs_rr": 3.52,
+    "fleet.drains": 1,
+    "fleet.restarts": 1,
+    "fleet.pages_leaked": 0,
+    "fleet.healthy_drains": 0,
+    "fleet.deterministic": True,
     "decode.paged_tokens_exact": True,
     "decode.pages_leaked": 0,
     "decode.kernel_tokens_exact": True,
